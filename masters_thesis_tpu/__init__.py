@@ -1,0 +1,20 @@
+"""masters_thesis_tpu — a TPU-native framework for single-factor return-model estimation.
+
+A brand-new JAX/XLA framework with the full capabilities of the reference
+masters-thesis codebase (an LSTM encoder estimating CAPM-style alpha/beta from
+lookback windows of returns), re-designed TPU-first:
+
+- ``ops``      — stateless numerical core (pure jnp, static shapes, jit-safe)
+- ``data``     — synthetic DGP, Fama-French ingestion, windowed dataset pipeline
+- ``models``   — Flax LSTM encoder + loss objectives fused into the train step
+- ``parallel`` — device meshes, shardings, collectives (DP/TP over ICI, multi-host)
+- ``train``    — native trainer: jitted steps, optax optimization, plateau LR,
+                 checkpointing, metric pytrees, TensorBoard event writing
+- ``config``   — Hydra-compatible config composition + multirun sweeps
+- ``viz``      — evaluation plots (model vs OLS vs ground truth)
+
+Reference capability map: see SURVEY.md section 2 (citations into
+/root/reference are given per-module in docstrings).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+"""Stateless numerical core: pure-``jnp``, static-shape, jit-safe primitives.
+
+TPU-native re-expression of the reference numerical layer
+(reference: src/common.py and the static NLL core of src/model.py:44-69).
+Every function here is traceable under ``jax.jit`` and free of Python-level
+data-dependent control flow, so XLA can fuse it into the surrounding step.
+"""
+
+from masters_thesis_tpu.ops.linalg import ols, inverse_returns_covariance
+from masters_thesis_tpu.ops.windows import (
+    lookback_target_split,
+    add_quadratic_features,
+    ols_features,
+)
+from masters_thesis_tpu.ops.losses import (
+    multivariate_gaussian_nll,
+    mean_squared_error,
+    LOG_2PI,
+)
+
+__all__ = [
+    "ols",
+    "inverse_returns_covariance",
+    "lookback_target_split",
+    "add_quadratic_features",
+    "ols_features",
+    "multivariate_gaussian_nll",
+    "mean_squared_error",
+    "LOG_2PI",
+]

@@ -1,0 +1,82 @@
+"""Batched linear-algebra primitives for the single-factor model.
+
+Capability parity: ``ols`` reproduces the reference's batched
+ordinary-least-squares solver (reference: src/common.py:5-47) and
+``inverse_returns_covariance`` its Woodbury-identity inverse covariance
+(reference: src/common.py:50-78) — re-designed as pure jnp functions so XLA
+lowers them to MXU dot-generals and fuses them into the enclosing jitted step
+(the reference runs them as eager CUDA kernel launches).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def ols(x: Array, y: Array) -> tuple[Array, Array]:
+    """Least-squares intercept + slope of ``y`` on ``x``, batched.
+
+    Solves ``y ≈ alpha + beta * x`` per stock via the normal equations with a
+    pseudo-inverse (robust to a degenerate/constant regressor).
+
+    Args:
+        x: regressor series — ``(n_samples,)`` or ``(batch, n_samples)``.
+        y: regressand series — ``(n_stocks, n_samples)`` or
+           ``(batch, n_stocks, n_samples)``.
+
+    Returns:
+        ``(alphas, betas)`` each ``(n_stocks,)`` / ``(batch, n_stocks)``;
+        size-1 dims are squeezed in the unbatched path, matching the
+        reference's unsqueeze/squeeze convention (src/common.py:21-27).
+    """
+    if x.ndim <= 2 and y.ndim <= 2:
+        alphas, betas = _batched_ols(x[None, ...], y[None, ...])
+        return alphas.squeeze(), betas.squeeze()
+    return _batched_ols(x, y)
+
+
+def _batched_ols(x: Array, y: Array) -> tuple[Array, Array]:
+    """Normal-equation OLS ``(XᵀX)⁺ Xᵀ yᵀ`` with an explicit intercept column.
+
+    x: (batch, n) — regressor.  y: (batch, k, n) — one row per stock.
+    """
+    design = jnp.stack([jnp.ones_like(x), x], axis=-1)  # (batch, n, 2)
+    # These are tiny, accuracy-sensitive contractions: pin them to full f32
+    # accumulation so TPU's default bf16 matmul mode cannot degrade the fit.
+    gram = jnp.matmul(design.mT, design, precision="highest")  # (batch, 2, 2)
+    moment = jnp.matmul(design.mT, y.mT, precision="highest")  # (batch, 2, k)
+    coef = jnp.matmul(jnp.linalg.pinv(gram), moment, precision="highest")
+    return coef[:, 0, :], coef[:, 1, :]
+
+
+def inverse_returns_covariance(
+    beta: Array, inv_psi: Array, f_var: Array
+) -> Array:
+    """Inverse of the single-factor return covariance via Woodbury.
+
+    The factor model implies ``Sigma = f_var * beta betaᵀ + Psi`` with
+    diagonal idiosyncratic covariance ``Psi``. Woodbury gives
+
+        Sigma⁻¹ = Psi⁻¹ − (Psi⁻¹ beta betaᵀ Psi⁻¹) / (1/f_var + betaᵀ Psi⁻¹ beta)
+
+    (reference: src/common.py:50-78). Kept as a rank-1 correction so the cost
+    is O(K²) instead of an O(K³) dense inverse, and everything fuses.
+
+    Args:
+        beta: ``(n_stocks, 1)`` factor loadings.
+        inv_psi: ``(n_stocks, n_stocks)`` diagonal inverse idiosyncratic cov.
+        f_var: scalar factor variance.
+
+    Returns:
+        ``(n_stocks, n_stocks)`` inverse covariance.
+    """
+    inv_psi_beta = jnp.matmul(inv_psi, beta, precision="highest")  # (K, 1)
+    beta_t_inv_psi = jnp.matmul(beta.T, inv_psi, precision="highest")  # (1, K)
+    denominator = 1.0 / f_var + jnp.matmul(
+        beta_t_inv_psi, beta, precision="highest"
+    )  # (1, 1)
+    correction = (
+        jnp.matmul(inv_psi_beta, beta_t_inv_psi, precision="highest") / denominator
+    )
+    return inv_psi - correction

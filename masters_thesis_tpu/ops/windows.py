@@ -1,0 +1,139 @@
+"""Windowed-dataset construction: lookback/target splitting and feature maps.
+
+Capability parity with the reference's window pipeline
+(reference: src/common.py:81-148). The reference uses torch ``unfold`` (a
+strided view); here windows are materialized with a gather over precomputed
+start indices — static shapes throughout, so the whole pipeline jit-compiles
+and can run on device or host.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from masters_thesis_tpu.ops.linalg import ols
+
+
+def lookback_target_split(
+    r_stocks: Array,
+    r_market: Array,
+    lookback_window: int,
+    target_window: int,
+    stride: int | None = None,
+    prediction: bool = True,
+) -> tuple[Array, Array]:
+    """Slice return series into strided (lookback, target) window pairs.
+
+    Stocks and market are broadcast against each other, stacked on a trailing
+    channel axis, and windowed along time (reference: src/common.py:81-112).
+
+    Args:
+        r_stocks: ``(n_stocks, n_samples)`` stock return series.
+        r_market: ``(n_samples,)`` market return series (broadcast to stocks).
+        lookback_window: encoder context length.
+        target_window: supervision horizon length.
+        stride: window start spacing; defaults to ``lookback + target``
+            (non-overlapping).
+        prediction: if True, target is the ``target_window`` steps *after* the
+            lookback (disjoint X/y); if False (reconstruction), the target is
+            the trailing ``target_window`` steps *inside* the lookback.
+
+    Returns:
+        ``X``: ``(n_windows, n_stocks, lookback_window, 2)`` and
+        ``y``: ``(n_windows, n_stocks, target_window or lookback_window, 2)``
+        with channels ``[r_stock, r_market]``.
+    """
+    if stride is None:
+        stride = lookback_window + target_window
+
+    if not prediction and target_window > lookback_window:
+        raise ValueError(
+            f"reconstruction task requires target_window ({target_window}) <= "
+            f"lookback_window ({lookback_window})"
+        )
+
+    total_window = lookback_window + target_window if prediction else lookback_window
+
+    stacked = jnp.stack(jnp.broadcast_arrays(r_stocks, r_market), axis=-1)
+    n_samples = stacked.shape[1]
+    n_windows = (n_samples - total_window) // stride + 1
+    if n_windows < 1:
+        raise ValueError(
+            f"series of length {n_samples} is shorter than one window "
+            f"({total_window} steps); no windows can be formed"
+        )
+
+    starts = jnp.arange(n_windows) * stride
+    gather = starts[:, None] + jnp.arange(total_window)[None, :]  # (n_win, tw)
+    windowed = stacked[:, gather, :]  # (n_stocks, n_win, tw, 2)
+    windowed = jnp.transpose(windowed, (1, 0, 2, 3))  # (n_win, n_stocks, tw, 2)
+
+    if prediction:
+        x = windowed[:, :, :lookback_window, :]
+        y = windowed[:, :, lookback_window:, :]
+    else:
+        x = windowed
+        y = windowed[:, :, lookback_window - target_window :, :]
+    return x, y
+
+
+def add_quadratic_features(
+    x: Array, interaction_only: bool = False, include_bias: bool = False
+) -> Array:
+    """Expand the 2-channel window into polynomial features.
+
+    Produces ``[r_stock, r_market, r_stock*r_market]`` plus the squares when
+    not ``interaction_only``, plus an optional all-ones bias channel
+    (reference: src/common.py:115-130).
+
+    Args:
+        x: ``(n_windows, n_stocks, window, 2)``.
+
+    Returns:
+        ``(n_windows, n_stocks, window, n_features)`` with 3..6 features.
+    """
+    r_stock = x[..., 0]
+    r_market = x[..., 1]
+    features = [r_stock, r_market, r_stock * r_market]
+    if not interaction_only:
+        features.extend([r_stock * r_stock, r_market * r_market])
+    if include_bias:
+        features.append(jnp.ones_like(r_stock))
+    return jnp.stack(features, axis=-1)
+
+
+def ols_features(target: Array) -> tuple[Array, Array, Array, Array]:
+    """Per-window OLS supervision features from the *target* window.
+
+    Fits ``r_stock ≈ alpha + beta * r_market`` on each target window, then
+    summarizes the factor (mean/var of market returns) and the inverse
+    idiosyncratic variance of the fit residuals — these become the labels and
+    NLL plug-ins downstream (reference: src/common.py:132-148).
+
+    Variances are unbiased (ddof=1), matching torch's default ``var``.
+
+    Args:
+        target: ``(n_windows, n_stocks, target_window, >=2)`` with channels
+            ``[r_stock, r_market, ...]``.
+
+    Returns:
+        ``alphas``: ``(n_windows, n_stocks)``,
+        ``betas``: ``(n_windows, n_stocks)``,
+        ``factor``: ``(n_windows, 2)`` = (market mean, market var),
+        ``inv_psi``: ``(n_windows, n_stocks)`` = 1 / var(residuals).
+    """
+    r_stocks = target[:, :, :, 0]  # (n_win, n_stocks, tw)
+    r_market = target[:, 0, :, 1]  # (n_win, tw) — market identical across stocks
+
+    alphas, betas = ols(r_market, r_stocks)
+
+    r_pred = alphas[..., None] + betas[..., None] * r_market[:, None, :]
+    residuals = r_stocks - r_pred
+
+    factor = jnp.stack(
+        [r_market.mean(axis=-1), r_market.var(axis=-1, ddof=1)], axis=-1
+    )
+    psi = residuals.var(axis=-1, ddof=1)
+    inv_psi = 1.0 / psi
+    return alphas, betas, factor, inv_psi

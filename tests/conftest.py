@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the fake-backend multi-chip story the reference lacks (SURVEY.md §4):
+every test — including sharding/collective tests — runs against a simulated
+8-device mesh on CPU, so distributed code paths are exercised without TPU
+hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import numpy as np
+import pytest
+
+# Persistent compilation cache: repeated test runs skip XLA recompiles.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

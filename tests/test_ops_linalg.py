@@ -1,0 +1,92 @@
+"""Unit tests for the numerical core: OLS and Woodbury inverse covariance.
+
+Oracles are closed forms / numpy lstsq / dense inverses — independent of both
+the reference implementation and the code under test (SURVEY.md §4 test plan).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from masters_thesis_tpu.ops import ols, inverse_returns_covariance
+
+
+def _lstsq_oracle(x, y):
+    """Per-row numpy lstsq fit of y ≈ a + b x."""
+    design = np.stack([np.ones_like(x), x], axis=-1)
+    coef, *_ = np.linalg.lstsq(design, y.T, rcond=None)
+    return coef[0], coef[1]
+
+
+def test_ols_unbatched_matches_lstsq(rng):
+    x = rng.normal(size=50).astype(np.float32)
+    y = rng.normal(size=(7, 50)).astype(np.float32)
+    alphas, betas = ols(jnp.asarray(x), jnp.asarray(y))
+    a_ref, b_ref = _lstsq_oracle(x, y)
+    np.testing.assert_allclose(alphas, a_ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(betas, b_ref, rtol=1e-4, atol=1e-4)
+    assert alphas.shape == (7,)
+
+
+def test_ols_batched_matches_lstsq(rng):
+    x = rng.normal(size=(4, 30)).astype(np.float32)
+    y = rng.normal(size=(4, 5, 30)).astype(np.float32)
+    alphas, betas = ols(jnp.asarray(x), jnp.asarray(y))
+    assert alphas.shape == (4, 5)
+    for b in range(4):
+        a_ref, b_ref = _lstsq_oracle(x[b], y[b])
+        np.testing.assert_allclose(alphas[b], a_ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(betas[b], b_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ols_recovers_exact_line():
+    x = jnp.linspace(-1.0, 1.0, 20)
+    y = (2.5 + 0.5 * x)[None, :]
+    alphas, betas = ols(x, y)
+    np.testing.assert_allclose(float(alphas), 2.5, atol=1e-5)
+    np.testing.assert_allclose(float(betas), 0.5, atol=1e-5)
+
+
+def test_ols_degenerate_regressor_uses_pinv():
+    # Constant market → singular Gram matrix; pinv must not blow up.
+    x = jnp.ones(10)
+    y = jnp.ones((3, 10)) * 2.0
+    alphas, betas = ols(x, y)
+    assert np.all(np.isfinite(np.asarray(alphas)))
+    assert np.all(np.isfinite(np.asarray(betas)))
+    # Pseudo-inverse solution predicts the mean: alpha + beta*1 == 2.
+    np.testing.assert_allclose(np.asarray(alphas + betas), 2.0, atol=1e-4)
+
+
+def test_ols_is_jittable(rng):
+    x = jnp.asarray(rng.normal(size=(2, 16)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(2, 3, 16)).astype(np.float32))
+    eager = ols(x, y)
+    jitted = jax.jit(ols)(x, y)
+    np.testing.assert_allclose(eager[0], jitted[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(eager[1], jitted[1], rtol=1e-5, atol=1e-6)
+
+
+def test_woodbury_matches_dense_inverse(rng):
+    k = 12
+    beta = rng.normal(loc=1.0, scale=0.3, size=(k, 1)).astype(np.float64)
+    psi_diag = rng.uniform(0.5, 2.0, size=k).astype(np.float64)
+    f_var = 0.7
+
+    sigma = f_var * beta @ beta.T + np.diag(psi_diag)
+    dense_inv = np.linalg.inv(sigma)
+
+    woodbury = inverse_returns_covariance(
+        jnp.asarray(beta, dtype=jnp.float32),
+        jnp.asarray(np.diag(1.0 / psi_diag), dtype=jnp.float32),
+        jnp.asarray(f_var, dtype=jnp.float32),
+    )
+    np.testing.assert_allclose(np.asarray(woodbury), dense_inv, rtol=2e-3, atol=2e-3)
+
+
+def test_woodbury_symmetry(rng):
+    k = 8
+    beta = jnp.asarray(rng.normal(size=(k, 1)).astype(np.float32))
+    inv_psi = jnp.diag(jnp.asarray(rng.uniform(0.5, 2.0, size=k).astype(np.float32)))
+    out = inverse_returns_covariance(beta, inv_psi, jnp.float32(0.5))
+    np.testing.assert_allclose(out, out.T, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,64 @@
+"""Unit tests for the NLL / MSE loss cores against scipy oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.stats
+
+from masters_thesis_tpu.ops import (
+    multivariate_gaussian_nll,
+    mean_squared_error,
+    inverse_returns_covariance,
+)
+
+
+def _random_spd(k, rng):
+    a = rng.normal(size=(k, k))
+    return a @ a.T + k * np.eye(k)
+
+
+def test_nll_matches_scipy_logpdf(rng):
+    k, n = 6, 9
+    mean = rng.normal(size=(k, 1))
+    cov = _random_spd(k, rng)
+    target = rng.normal(size=(k, n))
+
+    nll = multivariate_gaussian_nll(
+        jnp.asarray(mean, jnp.float32),
+        jnp.asarray(np.linalg.inv(cov), jnp.float32),
+        jnp.asarray(target, jnp.float32),
+    )
+    oracle = -scipy.stats.multivariate_normal(mean[:, 0], cov).logpdf(target.T).sum()
+    np.testing.assert_allclose(float(nll), oracle, rtol=1e-4)
+
+
+def test_nll_nan_on_non_positive_definite(rng):
+    k, n = 5, 5  # odd K so det(-I) < 0
+    mean = jnp.zeros((k, 1))
+    target = jnp.asarray(rng.normal(size=(k, n)), jnp.float32)
+    bad = jnp.asarray(-np.eye(k), jnp.float32)  # negative determinant
+    assert np.isnan(float(multivariate_gaussian_nll(mean, bad, target)))
+
+
+def test_nll_grad_flows_through_woodbury(rng):
+    """End-to-end differentiability: d NLL / d beta must be finite — this is
+    the training path of the NLL objective (reference: src/model.py:245-249)."""
+    k, n = 5, 7
+    target = jnp.asarray(rng.normal(size=(k, n)), jnp.float32)
+    inv_psi = jnp.eye(k) * 2.0
+    f_var = jnp.float32(0.5)
+
+    def loss_fn(beta):
+        mean = beta * 0.1
+        inv_cov = inverse_returns_covariance(beta, inv_psi, f_var)
+        return multivariate_gaussian_nll(mean, inv_cov, target)
+
+    g = jax.grad(loss_fn)(jnp.ones((k, 1)))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_mse_matches_numpy(rng):
+    a = rng.normal(size=(10, 3))
+    b = rng.normal(size=(10, 3))
+    got = mean_squared_error(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32))
+    np.testing.assert_allclose(float(got), ((a - b) ** 2).mean(), rtol=1e-5)

@@ -1,0 +1,140 @@
+"""Unit tests for window splitting and feature construction."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.ops import (
+    lookback_target_split,
+    add_quadratic_features,
+    ols_features,
+)
+
+
+def _series(n_stocks=4, n_samples=200, seed=0):
+    rng = np.random.default_rng(seed)
+    r_stocks = rng.normal(size=(n_stocks, n_samples)).astype(np.float32)
+    r_market = rng.normal(size=n_samples).astype(np.float32)
+    return jnp.asarray(r_stocks), jnp.asarray(r_market)
+
+
+def test_split_shapes_prediction():
+    r_stocks, r_market = _series()
+    x, y = lookback_target_split(r_stocks, r_market, 60, 30, stride=90)
+    n_win = (200 - 90) // 90 + 1
+    assert x.shape == (n_win, 4, 60, 2)
+    assert y.shape == (n_win, 4, 30, 2)
+
+
+def test_split_default_stride_is_nonoverlapping():
+    r_stocks, r_market = _series(n_samples=300)
+    x, y = lookback_target_split(r_stocks, r_market, 60, 40)
+    assert x.shape[0] == 300 // 100
+
+
+def test_split_window_contents_match_manual_slices():
+    r_stocks, r_market = _series(n_stocks=2, n_samples=250)
+    lookback, target, stride = 10, 5, 7
+    x, y = lookback_target_split(r_stocks, r_market, lookback, target, stride)
+    for w in range(x.shape[0]):
+        start = w * stride
+        np.testing.assert_array_equal(
+            np.asarray(x[w, :, :, 0]), np.asarray(r_stocks[:, start : start + lookback])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(x[w, 0, :, 1]), np.asarray(r_market[start : start + lookback])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(y[w, :, :, 0]),
+            np.asarray(r_stocks[:, start + lookback : start + lookback + target]),
+        )
+
+
+def test_split_reconstruction_mode_overlaps():
+    r_stocks, r_market = _series(n_samples=100)
+    x, y = lookback_target_split(
+        r_stocks, r_market, 20, 8, stride=20, prediction=False
+    )
+    assert x.shape[2] == 20
+    assert y.shape[2] == 8
+    # Target is the tail of the lookback itself.
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x[:, :, 12:, :]))
+
+
+def test_quadratic_features_interaction_only():
+    r_stocks, r_market = _series()
+    x, _ = lookback_target_split(r_stocks, r_market, 10, 5, stride=15)
+    feats = add_quadratic_features(x, interaction_only=True)
+    assert feats.shape[-1] == 3
+    np.testing.assert_allclose(
+        np.asarray(feats[..., 2]),
+        np.asarray(x[..., 0] * x[..., 1]),
+        rtol=1e-6,
+    )
+
+
+def test_quadratic_features_full_and_bias():
+    r_stocks, r_market = _series()
+    x, _ = lookback_target_split(r_stocks, r_market, 10, 5, stride=15)
+    feats = add_quadratic_features(x, interaction_only=False, include_bias=True)
+    assert feats.shape[-1] == 6
+    np.testing.assert_allclose(np.asarray(feats[..., 3]), np.asarray(x[..., 0] ** 2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(feats[..., 5]), 1.0)
+
+
+def test_ols_features_recovers_planted_coefficients():
+    # Plant exact alpha/beta with tiny noise; ols_features must recover them.
+    rng = np.random.default_rng(3)
+    n_win, n_stocks, tw = 6, 5, 40
+    alphas = rng.normal(size=(n_win, n_stocks)).astype(np.float32)
+    betas = rng.normal(loc=1.0, size=(n_win, n_stocks)).astype(np.float32)
+    r_market = rng.normal(size=(n_win, tw)).astype(np.float32)
+    noise = 1e-3 * rng.normal(size=(n_win, n_stocks, tw)).astype(np.float32)
+    r_stocks = alphas[..., None] + betas[..., None] * r_market[:, None, :] + noise
+
+    target = jnp.stack(
+        [jnp.asarray(r_stocks), jnp.broadcast_to(r_market[:, None, :], r_stocks.shape)],
+        axis=-1,
+    )
+    a_hat, b_hat, factor, inv_psi = ols_features(target)
+    np.testing.assert_allclose(np.asarray(a_hat), alphas, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(b_hat), betas, atol=5e-3)
+    np.testing.assert_allclose(
+        np.asarray(factor[:, 0]), r_market.mean(axis=-1), atol=1e-5
+    )
+    # Unbiased variance (ddof=1), matching torch's default.
+    np.testing.assert_allclose(
+        np.asarray(factor[:, 1]), r_market.var(axis=-1, ddof=1), rtol=1e-4
+    )
+    assert np.all(np.asarray(inv_psi) > 0)
+
+
+def test_ols_features_inv_psi_is_inverse_residual_variance():
+    rng = np.random.default_rng(4)
+    n_win, n_stocks, tw = 3, 4, 25
+    r_stocks = rng.normal(size=(n_win, n_stocks, tw)).astype(np.float32)
+    r_market = rng.normal(size=(n_win, tw)).astype(np.float32)
+    target = jnp.stack(
+        [jnp.asarray(r_stocks), jnp.broadcast_to(r_market[:, None, :], r_stocks.shape)],
+        axis=-1,
+    )
+    a_hat, b_hat, _, inv_psi = ols_features(target)
+    a, b = np.asarray(a_hat), np.asarray(b_hat)
+    resid = r_stocks - (a[..., None] + b[..., None] * r_market[:, None, :])
+    np.testing.assert_allclose(
+        np.asarray(inv_psi), 1.0 / resid.var(axis=-1, ddof=1), rtol=1e-3
+    )
+
+
+def test_split_reconstruction_rejects_target_longer_than_lookback():
+    r_stocks, r_market = _series(n_samples=50)
+    x, y = lookback_target_split(r_stocks, r_market, 10, 10, stride=10, prediction=False)
+    assert y.shape[2] == 10
+    with pytest.raises(ValueError, match="reconstruction"):
+        lookback_target_split(r_stocks, r_market, 10, 15, stride=10, prediction=False)
+
+
+def test_split_rejects_series_shorter_than_window():
+    r_stocks, r_market = _series(n_samples=80)
+    with pytest.raises(ValueError, match="shorter than one window"):
+        lookback_target_split(r_stocks, r_market, 60, 30)
