@@ -1,0 +1,28 @@
+"""Data layer: synthetic DGP, Fama-French ingestion, windowed dataset pipeline.
+
+TPU-native replacement for the reference's data stack (reference: src/data.py):
+explicit-PRNG synthetic generation, host-side CSV ingestion, a hash-cached
+window-preparation pipeline, chronological splits, and host→HBM prefetched
+batch iteration (the reference delegates the last to torch DataLoader worker
+processes + pinned memory).
+"""
+
+from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.data.fama_french import FamaFrench25Portfolios
+from masters_thesis_tpu.data.pipeline import (
+    Batch,
+    FinancialWindowDataModule,
+    bootstrap_synthetic,
+    bootstrap_real,
+)
+from masters_thesis_tpu.data.prefetch import prefetch_to_device
+
+__all__ = [
+    "SyntheticLogReturns",
+    "FamaFrench25Portfolios",
+    "Batch",
+    "FinancialWindowDataModule",
+    "bootstrap_synthetic",
+    "bootstrap_real",
+    "prefetch_to_device",
+]

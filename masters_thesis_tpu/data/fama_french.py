@@ -1,0 +1,84 @@
+"""Fama-French 25-Portfolios daily dataset ingestion.
+
+Capability parity with the reference loader (reference: src/data.py:62-123):
+reads the Ken French data-library CSVs ("F-F_Research_Data_Factors_daily" and
+"25_Portfolios_5x5_Daily"), skips the documented header preambles plus the
+first ``skip_old_data`` rows, subtracts the risk-free rate, drops rows carrying
+the -99.99/-999 missing-data sentinels, and converts percent arithmetic
+returns to percent log returns ``100 * (log(R + 100) - log 100)``.
+
+Host-side by design: CSV parsing is pandas/numpy work; arrays are handed to
+the window pipeline as float32 numpy and only enter HBM once windowed.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+
+class FamaFrench25Portfolios:
+    """Loader for 25 Portfolios Formed on Size and Book-to-Market [Daily]."""
+
+    n_samples = 26129
+    skip_old_data = 3125
+
+    ff3_filename = "F-F_Research_Data_Factors_daily.csv"
+    ff3_skip = 4
+    ff3_cols = ["DATE", "Mkt-RF", "SMB", "HML", "RF"]
+
+    p25_filename = "25_Portfolios_5x5_Daily.csv"
+    p25_skip = 18
+    p25_cols = ["DATE", "SMALL LoBM", "ME1 BM2", "ME1 BM3", "ME1 BM4", "SMALL HiBM",
+                        "ME2 BM1", "ME2 BM2", "ME2 BM3", "ME2 BM4", "ME2 BM5",
+                        "ME3 BM1", "ME3 BM2", "ME3 BM3", "ME3 BM4", "ME3 BM5",
+                        "ME4 BM1", "ME4 BM2", "ME4 BM3", "ME4 BM4", "ME4 BM5",
+                        "BIG LoBM", "ME5 BM2", "ME5 BM3", "ME5 BM4", "BIG HiBM"]
+
+    @staticmethod
+    def load(data_dir: Path) -> tuple[np.ndarray, np.ndarray]:
+        """Load (portfolio log returns ``(25, T)``, market log returns ``(T,)``)."""
+        cls = FamaFrench25Portfolios
+        ff3_types = defaultdict(lambda: np.float32, DATE=np.int32)
+        ff3_df = pd.read_csv(
+            Path(data_dir) / cls.ff3_filename,
+            header=0,
+            index_col=0,
+            names=cls.ff3_cols,
+            usecols=["DATE", "Mkt-RF", "RF"],
+            dtype=ff3_types,
+            skiprows=cls.ff3_skip + cls.skip_old_data,
+            nrows=cls.n_samples - cls.skip_old_data,
+        )
+
+        p25_types = defaultdict(lambda: np.float32, DATE=np.int32)
+        p25_df = pd.read_csv(
+            Path(data_dir) / cls.p25_filename,
+            header=0,
+            index_col=0,
+            names=cls.p25_cols,
+            dtype=p25_types,
+            skiprows=cls.p25_skip + cls.skip_old_data,
+            nrows=cls.n_samples - cls.skip_old_data,
+        )
+
+        mkt_excess = ff3_df["Mkt-RF"].to_numpy(dtype=np.float32)
+        risk_free = ff3_df["RF"].to_numpy(dtype=np.float32)
+        p25_raw = p25_df.to_numpy(dtype=np.float32).T
+
+        # Drop days where any portfolio carries a missing-data sentinel.
+        # Conscious fix over the reference (src/data.py:112-115), which
+        # matches the sentinel only AFTER subtracting RF — on a day with
+        # nonzero RF the sentinel escapes and log(-99.99 - RF + 100) injects
+        # NaN. Matching on the raw values guards the log transform reliably.
+        missing = ((p25_raw == -99.99) | (p25_raw == -999)).any(axis=0)
+        p25_excess = (p25_raw - risk_free)[:, ~missing]
+        mkt_excess = mkt_excess[~missing]
+
+        # Percent arithmetic returns -> percent log returns.
+        mkt = 100.0 * (np.log(mkt_excess + 100.0) - np.log(100.0))
+        p25 = 100.0 * (np.log(p25_excess + 100.0) - np.log(100.0))
+        return p25.astype(np.float32), mkt.astype(np.float32)

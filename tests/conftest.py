@@ -16,6 +16,16 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax
+
+# Tests must be hermetic from the TPU: the ambient axon plugin
+# (sitecustomize in /root/.axon_site) registers at interpreter boot and
+# force-overrides the jax_platforms *config* to "axon,cpu" — so the env var
+# above is not enough, and any dispatch would claim the TPU relay session
+# (hanging every test run whenever the relay lease is wedged). Overriding the
+# config again, before any backend initializes, keeps the axon backend
+# registered-but-never-touched.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

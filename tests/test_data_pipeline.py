@@ -1,0 +1,153 @@
+"""Datamodule pipeline: preparation, cache semantics, splits, batching,
+prefetch, and bootstrap helpers."""
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.data import (
+    Batch,
+    FinancialWindowDataModule,
+    bootstrap_synthetic,
+    prefetch_to_device,
+)
+
+
+@pytest.fixture
+def synth_dir(tmp_path):
+    bootstrap_synthetic(tmp_path / "synthetic", n_stocks=6, n_samples=3000, seed=0)
+    return tmp_path / "synthetic"
+
+
+def _dm(synth_dir, **kw):
+    defaults = dict(
+        lookback_window=30, target_window=10, stride=40, batch_size=4
+    )
+    defaults.update(kw)
+    return FinancialWindowDataModule(synth_dir, **defaults)
+
+
+def test_bootstrap_synthetic_writes_once(synth_dir):
+    stocks = np.load(synth_dir / "stocks.npy")
+    assert stocks.shape == (6, 3000)
+    mtime = (synth_dir / "stocks.npy").stat().st_mtime_ns
+    bootstrap_synthetic(synth_dir, n_stocks=6, n_samples=3000, seed=0)
+    assert (synth_dir / "stocks.npy").stat().st_mtime_ns == mtime
+
+
+def test_prepare_and_setup_shapes(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    n_win = (3000 - 40) // 40 + 1
+    full = dm._arrays
+    assert full.x.shape == (n_win, 6, 30, 3)
+    assert full.y.shape == (n_win, 6, 10, 4)
+    assert full.factor.shape == (n_win, 2)
+    assert full.inv_psi.shape == (n_win, 6)
+    # Chronological 70/20/10.
+    assert dm.train_range == range(0, int(0.7 * n_win))
+    assert dm.val_range == range(int(0.7 * n_win), int(0.9 * n_win))
+    assert dm.test_range == range(int(0.9 * n_win), n_win)
+
+
+def test_synthetic_labels_are_ground_truth_constants(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    alphas = np.load(synth_dir / "alphas.npy")
+    betas = np.load(synth_dir / "betas.npy")
+    y = dm._arrays.y
+    # Channels 2/3 carry the per-stock ground truth, constant across windows
+    # and time steps (reference: src/data.py:209-214 appends true alpha/beta).
+    np.testing.assert_allclose(y[0, :, 0, 2], alphas, rtol=1e-6)
+    np.testing.assert_allclose(y[5, :, 3, 3], betas, rtol=1e-6)
+    assert np.all(y[:, :, :, 2] == y[:1, :, :1, 2])
+
+
+def test_real_data_fallback_uses_target_ols_labels(tmp_path):
+    # No alphas.npy/betas.npy -> labels come from the target-window OLS fit.
+    rng = np.random.default_rng(0)
+    d = tmp_path / "real"
+    d.mkdir()
+    np.save(d / "stocks.npy", rng.normal(size=(4, 1000)).astype(np.float32))
+    np.save(d / "market.npy", rng.normal(size=1000).astype(np.float32))
+    dm = FinancialWindowDataModule(
+        d, lookback_window=20, target_window=10, stride=30, batch_size=2
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    y = dm._arrays.y
+    # Labels vary per window (OLS of that window), unlike the synthetic case.
+    assert not np.all(y[:, :, 0, 3] == y[:1, :, 0, 3])
+
+
+def test_cache_hit_skips_rebuild_and_param_change_rebuilds(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    ds_file = synth_dir / "datasets" / "dataset.npz"
+    mtime = ds_file.stat().st_mtime_ns
+    dm.prepare_data(verbose=False)  # cache hit
+    assert ds_file.stat().st_mtime_ns == mtime
+    dm2 = _dm(synth_dir, stride=50)
+    dm2.prepare_data(verbose=False)  # different hparams -> rebuild
+    assert ds_file.stat().st_mtime_ns != mtime
+
+
+def test_train_batches_shuffled_deterministic(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    dm.setup("fit")
+    b1 = [b.factor for b in dm.train_batches(epoch=0, seed=7)]
+    b2 = [b.factor for b in dm.train_batches(epoch=0, seed=7)]
+    b3 = [b.factor for b in dm.train_batches(epoch=1, seed=7)]
+    for x, y in zip(b1, b2):
+        np.testing.assert_array_equal(x, y)
+    assert not all(np.array_equal(x, y) for x, y in zip(b1, b3))
+    # All windows served exactly once.
+    assert sum(b.shape[0] for b in b1) == len(dm.train_range)
+
+
+def test_val_test_batches_sequential_bs1(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    vals = list(dm.val_batches())
+    assert all(b.x.shape[0] == 1 for b in vals)
+    np.testing.assert_array_equal(
+        vals[0].factor[0], dm._arrays.factor[dm.val_range.start]
+    )
+
+
+def test_train_arrays_device_resident_path(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    dm.setup("fit")
+    arrays = dm.train_arrays()
+    assert isinstance(arrays, Batch)
+    assert arrays.x.shape[0] == len(dm.train_range)
+
+
+def test_prefetch_preserves_order_and_content(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    dm.setup("fit")
+    host = list(dm.train_batches(epoch=0, seed=0))
+    fetched = list(prefetch_to_device(dm.train_batches(epoch=0, seed=0), size=3))
+    assert len(host) == len(fetched)
+    for h, f in zip(host, fetched):
+        np.testing.assert_allclose(np.asarray(f.x), h.x, rtol=1e-6)
+
+
+def test_reconstruction_guard(synth_dir):
+    with pytest.raises(ValueError, match="reconstruction"):
+        FinancialWindowDataModule(
+            synth_dir, lookback_window=10, target_window=20, prediction_task=False
+        )
+
+
+def test_teardown_cleanup_removes_cache(synth_dir):
+    dm = _dm(synth_dir)
+    dm.prepare_data(verbose=False)
+    assert (synth_dir / "datasets" / "dataset.npz").exists()
+    dm.teardown("cleanup")
+    assert not (synth_dir / "datasets").exists()

@@ -1,0 +1,53 @@
+"""Statistical tests for the synthetic DGP (distribution parity is the
+contract — exact sample parity with torch RNG is impossible, SURVEY.md §7)."""
+
+import numpy as np
+
+from masters_thesis_tpu.data import SyntheticLogReturns
+
+
+def test_generate_shapes_and_dtype():
+    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(7, 500, seed=0)
+    assert r_stocks.shape == (7, 500)
+    assert r_market.shape == (500,)
+    assert alphas.shape == (7,)
+    assert betas.shape == (7,)
+    assert r_stocks.dtype == np.float32
+
+
+def test_generate_is_deterministic_in_seed():
+    a = SyntheticLogReturns.generate(3, 100, seed=42)
+    b = SyntheticLogReturns.generate(3, 100, seed=42)
+    c = SyntheticLogReturns.generate(3, 100, seed=43)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_market_moments_match_student_t_parameters():
+    _, r_market, _, _ = SyntheticLogReturns.generate(1, 200_000, seed=1)
+    p = SyntheticLogReturns.mkt_params
+    # Student-t(df) scaled: mean=loc, var=scale^2 * df/(df-2).
+    expected_var = p["scale"] ** 2 * p["df"] / (p["df"] - 2.0)
+    assert abs(r_market.mean() - p["loc"]) < 0.02
+    assert abs(r_market.var() - expected_var) < 0.15 * expected_var
+
+
+def test_alpha_beta_population_moments():
+    _, _, alphas, betas = SyntheticLogReturns.generate(20_000, 2, seed=2)
+    pa, pb = SyntheticLogReturns.alpha_params, SyntheticLogReturns.beta_params
+    assert abs(alphas.mean() - pa["loc"]) < 0.01
+    assert abs(alphas.std() - pa["scale"]) < 0.01
+    assert abs(betas.mean() - pb["loc"]) < 0.02
+    assert abs(betas.std() - pb["scale"]) < 0.02
+
+
+def test_factor_structure_regression_recovers_beta():
+    """End-to-end oracle: regressing generated stocks on the generated market
+    must recover the sampled betas (SURVEY.md §4, synthetic-oracle strategy)."""
+    s, m, alphas, betas = SyntheticLogReturns.generate(10, 50_000, seed=3)
+    cov = ((s - s.mean(1, keepdims=True)) * (m - m.mean())).mean(1)
+    beta_hat = cov / m.var()
+    np.testing.assert_allclose(beta_hat, betas, atol=0.05)
+    alpha_hat = s.mean(1) - beta_hat * m.mean()
+    np.testing.assert_allclose(alpha_hat, alphas, atol=0.05)
