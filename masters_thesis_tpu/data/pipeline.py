@@ -234,7 +234,7 @@ class FinancialWindowDataModule:
     # --------------------------------------------------------------- serving
 
     def _iterate(
-        self, window_range: range, batch_size: int, shuffle_seed: int | None
+        self, window_range: range, batch_size: int, shuffle_seed
     ) -> Iterator[Batch]:
         order = np.asarray(window_range)
         if shuffle_seed is not None:
@@ -245,8 +245,10 @@ class FinancialWindowDataModule:
     def train_batches(self, epoch: int = 0, seed: int = 0) -> Iterator[Batch]:
         """Shuffled train batches; shuffle order is (seed, epoch)-deterministic."""
         assert self.train_range is not None, "call setup('fit') first"
+        # Sequence seed, not hash((seed, epoch)): tuple hashing is a CPython
+        # implementation detail and would break cross-version reproducibility.
         return self._iterate(
-            self.train_range, self.batch_size, shuffle_seed=hash((seed, epoch)) & 0x7FFFFFFF
+            self.train_range, self.batch_size, shuffle_seed=(seed, epoch)
         )
 
     def val_batches(self) -> Iterator[Batch]:

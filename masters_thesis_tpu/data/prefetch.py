@@ -27,6 +27,9 @@ def prefetch_to_device(
             (used by the data-parallel trainer to shard the batch axis);
             default places on the default device.
     """
+    if size < 0:
+        raise ValueError(f"prefetch size must be >= 0, got {size}")
+
     queue: collections.deque = collections.deque()
 
     def put(item):
@@ -35,6 +38,10 @@ def prefetch_to_device(
         return jax.device_put(item)
 
     it = iter(iterator)
+    if size == 0:  # no lookahead: plain put-then-yield
+        for item in it:
+            yield put(item)
+        return
     try:
         for _ in range(size):
             queue.append(put(next(it)))

@@ -1,0 +1,31 @@
+"""Model layer: Flax LSTM encoder + loss objectives as pure functions.
+
+TPU-native re-design of the reference's LightningModule hierarchy
+(reference: src/model.py:72-331). The reference couples network, loss, and
+training loop into one class per objective; here the *network* is a single
+Flax module, each *objective* is a pure function fused into the jitted train
+step, and the *loop* lives in ``masters_thesis_tpu.train`` — the idiomatic
+JAX factoring of the same capability surface.
+"""
+
+from masters_thesis_tpu.models.lstm import LstmEncoder
+from masters_thesis_tpu.models.objectives import (
+    ModelSpec,
+    MODEL_REGISTRY,
+    get_model_spec,
+    mse_window,
+    nll_window,
+    make_combined_window,
+    batched_objective,
+)
+
+__all__ = [
+    "LstmEncoder",
+    "ModelSpec",
+    "MODEL_REGISTRY",
+    "get_model_spec",
+    "mse_window",
+    "nll_window",
+    "make_combined_window",
+    "batched_objective",
+]

@@ -1,0 +1,155 @@
+"""Loss objectives as pure per-window functions + the model registry.
+
+Capability parity with the reference's three LightningModule variants
+(reference: src/model.py:176-331): MSE, multivariate-Gaussian NLL with the
+Woodbury inverse covariance, and the Combined objective
+``NLL + mse_weight * MSE``.
+
+Each objective is a pure function of one window's model outputs and labels;
+``batched_objective`` vmaps it over the batch of windows and averages. At the
+reference's batch_size=1 this is numerically identical to the reference's
+per-step losses; for larger batches it generalizes the NLL correctly (each
+window keeps its own factor statistics — the reference's flatten(0,1)
+handling is only well-defined at batch_size=1). Everything here traces into
+the jitted train step, so the objective choice is fused into one XLA program
+(the BASELINE.json north star: "configs/loss is traced and fused into the
+train step").
+
+Batch window schema (see masters_thesis_tpu.data.pipeline.Batch):
+``y``: (K, T, 4) channels [r_stock, r_market, alpha, beta];
+``factor``: (2,) = (market mean, market var); ``inv_psi``: (K,).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from masters_thesis_tpu.ops import (
+    inverse_returns_covariance,
+    mean_squared_error,
+    multivariate_gaussian_nll,
+)
+
+# (loss, metric sums) for one window; metric sums are psum/accumulation-ready
+# (value_sum, weight) pairs mirroring torchmetrics' dist_reduce_fx="sum"
+# states (reference: src/model.py:24-25).
+WindowObjective = Callable[..., tuple[Array, dict[str, tuple[Array, Array]]]]
+
+
+def mse_window(
+    alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array
+) -> tuple[Array, dict]:
+    """MSE of ``alpha + beta * r_market`` vs realized returns over the target
+    window (reference: src/model.py:192-202)."""
+    r_target = y[:, :, 0]
+    r_market = y[:, :, 1]
+    r_pred = alpha + beta * r_market  # (K,1) broadcast over (K,T)
+    loss = mean_squared_error(r_pred, r_target)
+    n = jnp.float32(r_target.size)
+    return loss, {"mse": (loss * n, n)}
+
+
+def nll_window(
+    alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array
+) -> tuple[Array, dict]:
+    """Multivariate-Gaussian NLL with single-factor Woodbury inverse
+    covariance (reference: src/model.py:234-249)."""
+    r_target = y[:, :, 0]
+    f_mean, f_var = factor[0], factor[1]
+    r_mean = alpha + beta * f_mean  # (K, 1)
+    inv_cov = inverse_returns_covariance(beta, jnp.diag(inv_psi), f_var)
+    loss = multivariate_gaussian_nll(r_mean, inv_cov, r_target)
+    return loss, {"nll": (loss, jnp.float32(1.0))}
+
+
+def make_combined_window(mse_weight: float) -> WindowObjective:
+    """``NLL + mse_weight * MSE`` (reference: src/model.py:308-319; default
+    weight 1e2 at src/model.py:275, 100 via configs/loss/combined.yaml)."""
+
+    def combined_window(alpha, beta, y, factor, inv_psi):
+        mse_loss, mse_metrics = mse_window(alpha, beta, y, factor, inv_psi)
+        nll_loss, nll_metrics = nll_window(alpha, beta, y, factor, inv_psi)
+        loss = nll_loss + mse_weight * mse_loss
+        return loss, {**mse_metrics, **nll_metrics}
+
+    return combined_window
+
+
+def batched_objective(window_fn: WindowObjective):
+    """Lift a per-window objective over a batch of windows.
+
+    Returns ``fn(alpha (B,K,1), beta (B,K,1), batch) -> (mean loss, metric
+    sums)`` where metric sums aggregate across the batch (ready for further
+    psum across devices).
+    """
+
+    def fn(alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array):
+        losses, metrics = jax.vmap(window_fn)(alpha, beta, y, factor, inv_psi)
+        loss = jnp.mean(losses)
+        summed = {
+            k: (jnp.sum(v[0]), jnp.sum(v[1])) for k, v in metrics.items()
+        }
+        return loss, summed
+
+    return fn
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Hyperparameter bundle for one configured model + objective.
+
+    Mirrors the reference constructor surface (reference: src/model.py:77-85,
+    265-276 and train.py:124-136): same fields, same defaults.
+    """
+
+    objective: str  # 'mse' | 'nll' | 'combined'
+    input_size: int = 3
+    hidden_size: int = 64
+    num_layers: int = 2
+    dropout: float = 0.2
+    learning_rate: float = 1e-4
+    weight_decay: float = 1e-5
+    mse_weight: float = 1e2
+
+    def build_module(self, compute_dtype=jnp.float32):
+        from masters_thesis_tpu.models.lstm import LstmEncoder
+
+        return LstmEncoder(
+            hidden_size=self.hidden_size,
+            num_layers=self.num_layers,
+            dropout=self.dropout,
+            compute_dtype=compute_dtype,
+        )
+
+    def window_objective(self) -> WindowObjective:
+        if self.objective == "mse":
+            return mse_window
+        if self.objective == "nll":
+            return nll_window
+        if self.objective == "combined":
+            return make_combined_window(self.mse_weight)
+        raise ValueError(f"unknown objective: {self.objective}")
+
+
+# String registry keeping the reference's CLI class names working
+# (reference: train.py:45-67).
+MODEL_REGISTRY: dict[str, str] = {
+    "FinancialLstmMse": "mse",
+    "FinancialLstmNll": "nll",
+    "FinancialLstmCombined": "combined",
+}
+
+
+def get_model_spec(module_class_name: str, **hparams) -> ModelSpec:
+    """Map a reference-style class name to a configured ModelSpec."""
+    if module_class_name not in MODEL_REGISTRY:
+        raise ValueError(
+            f"Unknown module class: {module_class_name}. "
+            f"Available: {list(MODEL_REGISTRY.keys())}"
+        )
+    return ModelSpec(objective=MODEL_REGISTRY[module_class_name], **hparams)

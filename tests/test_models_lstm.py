@@ -1,0 +1,153 @@
+"""LSTM encoder tests, including the numerical cross-check against
+torch.nn.LSTM (the cuDNN-parity risk called out in SURVEY.md §7)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.models import LstmEncoder
+
+
+def _init(model, batch=3, time=12, features=5, seed=0):
+    x = jnp.asarray(
+        np.random.default_rng(seed).normal(size=(batch, time, features)),
+        jnp.float32,
+    )
+    params = model.init(jax.random.PRNGKey(seed), x)
+    return params, x
+
+
+def test_output_shapes():
+    model = LstmEncoder(hidden_size=16, num_layers=2, dropout=0.2)
+    params, x = _init(model)
+    alpha, beta = model.apply(params, x)
+    assert alpha.shape == (3, 1)
+    assert beta.shape == (3, 1)
+    assert alpha.dtype == jnp.float32
+
+
+def test_param_init_is_symmetric_uniform():
+    model = LstmEncoder(hidden_size=32, num_layers=1, dropout=0.0)
+    params, _ = _init(model)
+    k = 1.0 / math.sqrt(32)
+    w = np.asarray(params["params"]["w_ih_l0"])
+    assert w.min() >= -k and w.max() <= k
+    assert w.min() < -0.8 * k and w.max() > 0.8 * k  # actually spans the range
+    assert abs(w.mean()) < 0.1 * k
+
+
+def test_dropout_train_vs_eval():
+    model = LstmEncoder(hidden_size=8, num_layers=3, dropout=0.5)
+    params, x = _init(model)
+    eval_out = model.apply(params, x, deterministic=True)
+    eval_out2 = model.apply(params, x, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(eval_out[0]), np.asarray(eval_out2[0]))
+
+    train_out = model.apply(
+        params, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    train_out2 = model.apply(
+        params, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(2)}
+    )
+    assert not np.allclose(np.asarray(train_out[0]), np.asarray(train_out2[0]))
+    assert not np.allclose(np.asarray(train_out[0]), np.asarray(eval_out[0]))
+
+
+def test_jit_matches_eager():
+    model = LstmEncoder(hidden_size=8, num_layers=2, dropout=0.2)
+    params, x = _init(model)
+    eager = model.apply(params, x)
+    jitted = jax.jit(lambda p, v: model.apply(p, v))(params, x)
+    np.testing.assert_allclose(
+        np.asarray(eager[0]), np.asarray(jitted[0]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_bf16_compute_close_to_f32():
+    model32 = LstmEncoder(hidden_size=16, num_layers=2, dropout=0.0)
+    params, x = _init(model32)
+    model16 = LstmEncoder(
+        hidden_size=16, num_layers=2, dropout=0.0, compute_dtype=jnp.bfloat16
+    )
+    a32, b32 = model32.apply(params, x)
+    a16, b16 = model16.apply(params, x)
+    assert a16.dtype == jnp.float32  # heads cast back
+    np.testing.assert_allclose(np.asarray(a32), np.asarray(a16), atol=0.05)
+
+
+@pytest.mark.parametrize("num_layers,features", [(1, 3), (2, 3), (3, 5)])
+def test_matches_torch_lstm(num_layers, features):
+    """Load identical weights into torch.nn.LSTM + Linear heads and into
+    LstmEncoder; outputs must agree to float32 tolerance."""
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(0)
+    hidden = 16
+    batch, time = 4, 20
+
+    t_lstm = torch.nn.LSTM(
+        input_size=features,
+        hidden_size=hidden,
+        num_layers=num_layers,
+        dropout=0.0,
+        batch_first=True,
+    )
+    t_alpha = torch.nn.Linear(hidden, 1)
+    t_beta = torch.nn.Linear(hidden, 1)
+
+    x_np = np.random.default_rng(1).normal(size=(batch, time, features)).astype(
+        np.float32
+    )
+    with torch.no_grad():
+        out, _ = t_lstm(torch.from_numpy(x_np))
+        final = out[:, -1, :]
+        ref_alpha = t_alpha(final).numpy()
+        ref_beta = t_beta(final).numpy()
+
+    model = LstmEncoder(hidden_size=hidden, num_layers=num_layers, dropout=0.0)
+    params = {"params": {}}
+    for layer in range(num_layers):
+        params["params"][f"w_ih_l{layer}"] = jnp.asarray(
+            getattr(t_lstm, f"weight_ih_l{layer}").detach().numpy()
+        )
+        params["params"][f"w_hh_l{layer}"] = jnp.asarray(
+            getattr(t_lstm, f"weight_hh_l{layer}").detach().numpy()
+        )
+        params["params"][f"b_ih_l{layer}"] = jnp.asarray(
+            getattr(t_lstm, f"bias_ih_l{layer}").detach().numpy()
+        )
+        params["params"][f"b_hh_l{layer}"] = jnp.asarray(
+            getattr(t_lstm, f"bias_hh_l{layer}").detach().numpy()
+        )
+    params["params"]["alpha_head"] = {
+        "kernel": jnp.asarray(t_alpha.weight.detach().numpy().T),
+        "bias": jnp.asarray(t_alpha.bias.detach().numpy()),
+    }
+    params["params"]["beta_head"] = {
+        "kernel": jnp.asarray(t_beta.weight.detach().numpy().T),
+        "bias": jnp.asarray(t_beta.bias.detach().numpy()),
+    }
+
+    alpha, beta = model.apply(params, jnp.asarray(x_np))
+    np.testing.assert_allclose(np.asarray(alpha), ref_alpha, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(beta), ref_beta, rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_flow_through_all_layers():
+    model = LstmEncoder(hidden_size=8, num_layers=2, dropout=0.0)
+    params, x = _init(model)
+
+    def loss_fn(p):
+        a, b = model.apply(p, x)
+        return jnp.sum(a**2) + jnp.sum(b**2)
+
+    grads = jax.grad(loss_fn)(params)
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    assert any(np.any(np.asarray(g) != 0) for g in flat)
+    # Recurrent weights of both layers receive gradient.
+    for layer in range(2):
+        g = np.asarray(grads["params"][f"w_hh_l{layer}"])
+        assert np.any(g != 0)

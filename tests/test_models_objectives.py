@@ -1,0 +1,114 @@
+"""Objective-function tests: parity relationships, batching semantics,
+registry surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.models import (
+    LstmEncoder,
+    ModelSpec,
+    batched_objective,
+    get_model_spec,
+    make_combined_window,
+    mse_window,
+    nll_window,
+)
+
+
+def _window(k=6, t=10, seed=0):
+    rng = np.random.default_rng(seed)
+    alpha = jnp.asarray(rng.normal(size=(k, 1)), jnp.float32)
+    beta = jnp.asarray(rng.normal(loc=1.0, size=(k, 1)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(k, t, 4)), jnp.float32)
+    factor = jnp.asarray([0.05, 0.3], jnp.float32)
+    inv_psi = jnp.asarray(rng.uniform(0.5, 2.0, size=k), jnp.float32)
+    return alpha, beta, y, factor, inv_psi
+
+
+def test_mse_window_matches_manual():
+    alpha, beta, y, factor, inv_psi = _window()
+    loss, metrics = mse_window(alpha, beta, y, factor, inv_psi)
+    pred = np.asarray(alpha) + np.asarray(beta) * np.asarray(y[:, :, 1])
+    expected = ((pred - np.asarray(y[:, :, 0])) ** 2).mean()
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+    s, n = metrics["mse"]
+    np.testing.assert_allclose(float(s) / float(n), expected, rtol=1e-5)
+
+
+def test_nll_window_is_finite_and_penalizes_bad_mean():
+    alpha, beta, y, factor, inv_psi = _window()
+    loss, metrics = nll_window(alpha, beta, y, factor, inv_psi)
+    assert np.isfinite(float(loss))
+    worse, _ = nll_window(alpha + 10.0, beta, y, factor, inv_psi)
+    assert float(worse) > float(loss)
+
+
+def test_combined_is_weighted_sum():
+    alpha, beta, y, factor, inv_psi = _window()
+    mse, _ = mse_window(alpha, beta, y, factor, inv_psi)
+    nll, _ = nll_window(alpha, beta, y, factor, inv_psi)
+    for w in (0.0, 1.0, 100.0):
+        comb, metrics = make_combined_window(w)(alpha, beta, y, factor, inv_psi)
+        np.testing.assert_allclose(float(comb), float(nll) + w * float(mse), rtol=1e-5)
+        assert set(metrics) == {"mse", "nll"}
+
+
+def test_batched_objective_means_over_windows():
+    b = 5
+    windows = [_window(seed=i) for i in range(b)]
+    batch = [jnp.stack([w[j] for w in windows]) for j in range(5)]
+    loss, metrics = batched_objective(nll_window)(*batch)
+    per_window = [float(nll_window(*w)[0]) for w in windows]
+    np.testing.assert_allclose(float(loss), np.mean(per_window), rtol=1e-5)
+    s, n = metrics["nll"]
+    np.testing.assert_allclose(float(s), np.sum(per_window), rtol=1e-5)
+    assert float(n) == b
+
+
+def test_batched_mse_equals_flattened_mse():
+    """Mean-of-per-window MSE == MSE over the flattened batch (the
+    reference's flatten(0,1) formulation, src/model.py:193) when windows are
+    equal-sized."""
+    b = 4
+    windows = [_window(seed=10 + i) for i in range(b)]
+    batch = [jnp.stack([w[j] for w in windows]) for j in range(5)]
+    loss, _ = batched_objective(mse_window)(*batch)
+    alpha, beta, y = np.asarray(batch[0]), np.asarray(batch[1]), np.asarray(batch[2])
+    pred = alpha + beta * y[:, :, :, 1]
+    flat = ((pred - y[:, :, :, 0]) ** 2).mean()
+    np.testing.assert_allclose(float(loss), flat, rtol=1e-5)
+
+
+def test_objective_differentiable_through_model():
+    spec = ModelSpec(objective="combined", hidden_size=8, num_layers=2)
+    model = spec.build_module()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 4, 12, 3)), jnp.float32)  # (B,K,T,F)
+    y = jnp.asarray(rng.normal(size=(3, 4, 6, 4)), jnp.float32)
+    factor = jnp.asarray(rng.normal(size=(3, 2)) ** 2 + 0.1, jnp.float32)
+    inv_psi = jnp.asarray(rng.uniform(0.5, 2.0, size=(3, 4)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x[0])
+    objective = batched_objective(spec.window_objective())
+
+    def loss_fn(p):
+        alpha, beta = jax.vmap(lambda xi: model.apply(p, xi))(x)
+        loss, _ = objective(alpha, beta, y, factor, inv_psi)
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    assert all(
+        np.all(np.isfinite(np.asarray(g)))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
+def test_registry_surface():
+    spec = get_model_spec("FinancialLstmNll", hidden_size=32, num_layers=4)
+    assert spec.objective == "nll"
+    assert spec.hidden_size == 32
+    with pytest.raises(ValueError, match="Unknown module class"):
+        get_model_spec("FinancialLstmBogus")
+    assert isinstance(spec.build_module(), LstmEncoder)
