@@ -1,0 +1,27 @@
+"""Native Hydra-compatible configuration engine.
+
+The reference composes its run config with Hydra (reference: train.py:70,
+configs/config.yaml:1-7): a ``defaults`` list selects one option per config
+group, ``${...}`` interpolations derive values, CLI ``key=value`` overrides
+mutate anything, and ``-m`` expands comma-separated overrides into a
+cartesian sweep. Hydra is not part of this framework's dependency set, so
+the same semantics are implemented natively here in ~300 lines: the CLI
+surface (``python train.py model=large loss=nll``, ``-m lr=1e-3,1e-4``)
+is part of the capability contract (SURVEY.md §7) and must keep working.
+"""
+
+from masters_thesis_tpu.config.compose import (
+    Config,
+    compose,
+    expand_multirun,
+    register_resolver,
+    to_flat_dict,
+)
+
+__all__ = [
+    "Config",
+    "compose",
+    "expand_multirun",
+    "register_resolver",
+    "to_flat_dict",
+]

@@ -1,0 +1,105 @@
+"""Config engine tests: composition, interpolation, overrides, multirun.
+
+Exercises the real configs/ tree at the repo root (the same one train.py
+uses) plus synthetic fixtures for edge cases.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from masters_thesis_tpu.config import (
+    compose,
+    expand_multirun,
+    register_resolver,
+    to_flat_dict,
+)
+
+CONFIG_DIR = Path(__file__).resolve().parent.parent / "configs"
+
+
+@pytest.fixture(autouse=True)
+def _resolver():
+    # Same derived config the reference registers (reference: train.py:39-42).
+    register_resolver(
+        "input_size_from_interaction", lambda interaction: 3 if interaction else 5
+    )
+
+
+def test_defaults_composition():
+    cfg = compose(CONFIG_DIR)
+    assert cfg.datamodule.name == "synthetic"
+    assert cfg.model.name == "small"
+    assert cfg.loss.name == "mse"
+    assert cfg.trainer.name == "fast"
+    assert cfg.model.num_layers == 2
+    assert cfg.checkpoint is None
+
+
+def test_group_override():
+    cfg = compose(CONFIG_DIR, overrides=["model=large", "loss=nll", "trainer=slow"])
+    assert cfg.model.num_layers == 8
+    assert cfg.loss.module_class == "FinancialLstmNll"
+    assert cfg.trainer.max_epochs == 32
+
+
+def test_value_override_is_typed():
+    cfg = compose(CONFIG_DIR, overrides=["model.learning_rate=1e-3"])
+    assert cfg.model.learning_rate == pytest.approx(1e-3)
+    assert isinstance(cfg.model.learning_rate, float)
+
+
+def test_unknown_value_override_rejected():
+    with pytest.raises(KeyError):
+        compose(CONFIG_DIR, overrides=["model.does_not_exist=3"])
+
+
+def test_add_and_delete_overrides():
+    cfg = compose(CONFIG_DIR, overrides=["+model.extra=7", "~launcher.verbose"])
+    assert cfg.model.extra == 7
+    assert "verbose" not in cfg.launcher
+
+
+def test_resolver_interpolation_nested():
+    # ${input_size_from_interaction:${datamodule.interaction_only}}
+    cfg = compose(CONFIG_DIR)
+    assert cfg.model.input_size == 3
+    cfg = compose(CONFIG_DIR, overrides=["datamodule.interaction_only=false"])
+    assert cfg.model.input_size == 5
+
+
+def test_string_interpolation_composes_version():
+    cfg = compose(CONFIG_DIR, overrides=["loss=combined", "model=medium"])
+    assert cfg.logger.name == "FinancialLstm/synthetic"
+    assert cfg.logger.version == "combined_medium_lr0.0001_fast"
+
+
+def test_interpolation_tracks_overrides():
+    cfg = compose(CONFIG_DIR, overrides=["model.learning_rate=0.01"])
+    assert "lr0.01" in cfg.logger.version
+
+
+def test_multirun_expansion_cartesian():
+    runs = expand_multirun(
+        ["datamodule=real", "model.learning_rate=1e-3,1e-4,1e-5", "trainer.max_epochs=100,200"]
+    )
+    assert len(runs) == 6
+    assert ["datamodule=real", "model.learning_rate=1e-3", "trainer.max_epochs=100"] in runs
+    assert ["datamodule=real", "model.learning_rate=1e-5", "trainer.max_epochs=200"] in runs
+
+
+def test_multirun_single_run_passthrough():
+    assert expand_multirun(["model=large"]) == [["model=large"]]
+
+
+def test_interpolation_cycle_detected(tmp_path):
+    (tmp_path / "config.yaml").write_text("a: ${b}\nb: ${a}\n")
+    with pytest.raises(ValueError, match="cycle"):
+        compose(tmp_path)
+
+
+def test_flat_dict():
+    cfg = compose(CONFIG_DIR)
+    flat = to_flat_dict(cfg)
+    assert flat["model.hidden_size"] == 64
+    assert flat["datamodule.lookback_window"] == 60
