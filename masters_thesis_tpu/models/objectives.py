@@ -85,7 +85,9 @@ def batched_objective(window_fn: WindowObjective):
 
     Returns ``fn(alpha (B,K,1), beta (B,K,1), batch) -> (mean loss, metric
     sums)`` where metric sums aggregate across the batch (ready for further
-    psum across devices).
+    psum across devices) and always include a ``"total"`` entry for the
+    objective itself. This is the single lifting used by the jitted train
+    step (masters_thesis_tpu.train.steps).
     """
 
     def fn(alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array):
@@ -94,6 +96,7 @@ def batched_objective(window_fn: WindowObjective):
         summed = {
             k: (jnp.sum(v[0]), jnp.sum(v[1])) for k, v in metrics.items()
         }
+        summed["total"] = (jnp.sum(losses), jnp.float32(losses.shape[0]))
         return loss, summed
 
     return fn
@@ -125,6 +128,16 @@ class ModelSpec:
             dropout=self.dropout,
             compute_dtype=compute_dtype,
         )
+
+    @property
+    def metric_keys(self) -> tuple:
+        """Per-objective logged metric names (reference logs loss/mse, loss/nll,
+        loss/total per variant: src/model.py:207-208,254-255,314-318)."""
+        return {
+            "mse": ("mse",),
+            "nll": ("nll",),
+            "combined": ("mse", "nll"),
+        }[self.objective]
 
     def window_objective(self) -> WindowObjective:
         if self.objective == "mse":

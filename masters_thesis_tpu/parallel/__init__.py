@@ -1,0 +1,27 @@
+"""Device mesh, sharding, and multi-host helpers.
+
+This is the framework's native replacement for the distribution machinery the
+reference delegates to Lightning/NCCL (reference: train.py:169-180 constructs
+a DDP-capable Trainer; src/model.py:24-25 relies on torchmetrics'
+``dist_reduce_fx="sum"`` cross-process reduction). Here the same roles are
+played by a ``jax.sharding.Mesh`` over ICI, ``NamedSharding`` annotations on
+the batch axis, and XLA-inserted collectives (psum for grads and metric
+states) — the scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives.
+"""
+
+from masters_thesis_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    distributed_initialize,
+    make_data_mesh,
+    replicated_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "distributed_initialize",
+    "make_data_mesh",
+    "replicated_sharding",
+]

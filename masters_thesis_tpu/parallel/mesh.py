@@ -1,0 +1,79 @@
+"""Mesh construction and batch-axis sharding for data-parallel training.
+
+The one real parallel axis in this workload is the window/batch dimension
+(SURVEY.md §2.2): windows are i.i.d. training examples, so data parallelism
+shards the leading batch axis across chips and lets XLA psum the gradients
+over ICI. Params stay replicated (the LSTM is ~100k params — far below the
+point where model parallelism would pay).
+
+Multi-host: each process calls :func:`distributed_initialize` first (wraps
+``jax.distributed.initialize``), then builds the same mesh over
+``jax.devices()`` — the global mesh spans all hosts, ICI within a slice,
+DCN across slices, with XLA routing collectives accordingly.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def distributed_initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize multi-host JAX (no-op for single-process runs).
+
+    Replaces the torch.distributed/NCCL process-group setup Lightning would
+    perform under DDP (latent in the reference; SURVEY.md §2.2). With no
+    arguments, reads the standard cluster env (TPU pod metadata / SLURM /
+    ``JAX_COORDINATOR_ADDRESS``).
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        if coordinator_address is None and num_processes is None:
+            jax.distributed.initialize()
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+    except (ValueError, RuntimeError):
+        # Single-process environment without coordinator metadata.
+        pass
+
+
+def make_data_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the data axis using the first ``n_devices`` devices.
+
+    On a real slice the device order from ``jax.devices()`` is
+    torus-contiguous, so neighbouring mesh coordinates are ICI neighbours and
+    the gradient psum rides ICI, not DCN.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=(DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh, batch_dim: int = 0) -> NamedSharding:
+    """Sharding that splits ``batch_dim`` over the data axis, rest replicated."""
+    spec = [None] * batch_dim + [DATA_AXIS]
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params, opt state, scalars)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+

@@ -1,0 +1,22 @@
+"""Native training stack: the framework's replacement for PyTorch-Lightning.
+
+The reference delegates its training loop, device placement, gradient
+clipping, checkpointing, LR scheduling, and metric reduction to Lightning
+(reference: train.py:169-198, src/model.py:149-172). Here those are owned
+in-tree, TPU-first:
+
+- :mod:`steps`: the whole training epoch is ONE jitted ``shard_map`` +
+  ``lax.scan`` program — no per-step host round trips at all.
+- :mod:`optim`: optax chain matching torch ``Adam(weight_decay=...)`` +
+  Lightning ``gradient_clip_val`` semantics, plus a host-side
+  ReduceLROnPlateau equivalent.
+- :mod:`checkpoint`: Orbax best/last checkpoints with hparams sidecars.
+- :mod:`logging`: TensorBoard scalars/hparams/figures (same taxonomy as the
+  reference's TensorBoardLogger).
+- :mod:`trainer`: the fit/test orchestration loop.
+"""
+
+from masters_thesis_tpu.train.optim import PlateauScheduler, make_optimizer
+from masters_thesis_tpu.train.trainer import Trainer, TrainResult
+
+__all__ = ["PlateauScheduler", "make_optimizer", "Trainer", "TrainResult"]

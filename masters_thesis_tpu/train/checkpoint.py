@@ -1,0 +1,90 @@
+"""Orbax-backed checkpointing: best/last, with hparams sidecars.
+
+Replaces Lightning's ModelCheckpoint + ``save_hyperparameters`` reload path
+(reference: train.py:151-161 saves best-on-`loss/total/val` and last;
+src/model.py:188 + test.py:177-178 reload a module from checkpoint with its
+constructor hparams). Layout::
+
+    <ckpt_dir>/
+      best/   # orbax pytree: params, opt_state
+      last/
+      best.json / last.json   # hparams + training metadata sidecar
+
+Orbax handles multi-host coordination and HBM->host streaming natively;
+the JSON sidecar carries everything needed to rebuild the ModelSpec and
+DataModule without the training config (the ``load_from_checkpoint``
+equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shutil
+from pathlib import Path
+from typing import Any
+
+import flax.serialization as fser
+import jax
+import orbax.checkpoint as ocp
+
+from masters_thesis_tpu.models.objectives import ModelSpec
+
+
+def save_checkpoint(
+    ckpt_dir: Path,
+    tag: str,
+    params: Any,
+    opt_state: Any,
+    spec: ModelSpec,
+    meta: dict,
+) -> None:
+    """Atomically write ``<ckpt_dir>/<tag>`` (orbax) + ``<tag>.json`` sidecar."""
+    ckpt_dir = Path(ckpt_dir).resolve()
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    path = ckpt_dir / tag
+    if path.exists():
+        shutil.rmtree(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        # to_state_dict turns optax namedtuple states into pure dicts, so the
+        # restore side can rebuild any optimizer structure via from_state_dict
+        # without orbax needing the live pytree as a template.
+        ckptr.save(
+            path,
+            {
+                "params": params,
+                "opt_state": fser.to_state_dict(jax.device_get(opt_state)),
+            },
+        )
+        ckptr.wait_until_finished()
+    sidecar = {"spec": dataclasses.asdict(spec), "meta": meta}
+    if jax.process_index() == 0:
+        (ckpt_dir / f"{tag}.json").write_text(json.dumps(sidecar, indent=2))
+
+
+def restore_checkpoint(
+    ckpt_dir: Path, tag: str = "best"
+) -> tuple[Any, Any, ModelSpec, dict]:
+    """Load (params, opt_state, spec, meta) from a checkpoint directory.
+
+    Accepts either the checkpoint root (picks ``<tag>``) or a direct path to
+    a tagged checkpoint — mirroring how the reference's test.py takes the
+    checkpoint file path on the CLI (reference: test.py:153,177).
+    """
+    ckpt_dir = Path(ckpt_dir).resolve()
+    if (ckpt_dir / tag).exists():
+        path = ckpt_dir / tag
+        sidecar_path = ckpt_dir / f"{tag}.json"
+    else:
+        path = ckpt_dir
+        sidecar_path = ckpt_dir.parent / f"{ckpt_dir.name}.json"
+    sidecar = json.loads(sidecar_path.read_text())
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(path)
+    spec = ModelSpec(**sidecar["spec"])
+    return tree["params"], tree["opt_state"], spec, sidecar["meta"]
+
+
+def restore_opt_state(template: Any, raw: Any) -> Any:
+    """Rebuild an optax state pytree from its checkpointed state dict."""
+    return fser.from_state_dict(template, raw)

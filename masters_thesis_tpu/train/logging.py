@@ -1,0 +1,54 @@
+"""TensorBoard logging with the reference's directory and tag taxonomy.
+
+(reference: train.py:143-148 TensorBoardLogger(save_dir, name, version);
+scalar tags ``loss/{mse,nll,total}/{train,val}`` at src/model.py:207-208,
+254-255, 314-318; LR under ``lr-Adam`` via LearningRateMonitor
+train.py:162-165; final hparams + test metrics train.py:204-211; figures
+via ``add_figure`` test.py:94-145.)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from tensorboardX import SummaryWriter
+
+
+class TensorBoardLogger:
+    """Scalars, hparams, and figures under ``<save_dir>/<name>/<version>``."""
+
+    def __init__(self, save_dir: str | Path, name: str, version: str):
+        self.log_dir = Path(save_dir) / name / version
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self._writer: SummaryWriter | None = None
+
+    @property
+    def writer(self) -> SummaryWriter:
+        if self._writer is None:
+            self._writer = SummaryWriter(logdir=str(self.log_dir))
+        return self._writer
+
+    def log_scalar(self, tag: str, value: float, step: int) -> None:
+        self.writer.add_scalar(tag, float(value), step)
+
+    def log_scalars(self, scalars: dict[str, float], step: int) -> None:
+        for tag, value in scalars.items():
+            self.log_scalar(tag, value, step)
+
+    def log_hparams(self, hparams: dict[str, Any], metrics: dict[str, float]) -> None:
+        """Final hparams + metrics table (reference: train.py:204-211)."""
+        clean = {
+            k: (v if isinstance(v, (int, float, str, bool)) else str(v))
+            for k, v in hparams.items()
+            if v is not None
+        }
+        self.writer.add_hparams(clean, {k: float(v) for k, v in metrics.items()})
+
+    def log_figure(self, tag: str, figure, step: int = 0) -> None:
+        self.writer.add_figure(tag, figure, step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

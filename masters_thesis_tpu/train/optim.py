@@ -1,0 +1,90 @@
+"""Optimizer chain and LR plateau scheduling.
+
+Matches the reference's optimization setup (reference: src/model.py:149-172):
+``torch.optim.Adam(lr, weight_decay=1e-5)`` — torch Adam's ``weight_decay``
+is L2 regularization folded into the gradient *before* the Adam moments, not
+AdamW-style decoupled decay — plus Lightning's ``gradient_clip_val`` (global
+norm, applied to raw grads) and ``ReduceLROnPlateau(factor=0.5, patience=2)``
+monitoring the validation loss.
+
+The learning rate is NOT baked into the optax chain: the jitted epoch step
+receives it as a traced scalar, so the host-side plateau scheduler can change
+it between epochs without triggering an XLA recompile.
+"""
+
+from __future__ import annotations
+
+import math
+
+import optax
+
+
+def make_optimizer(
+    gradient_clip_val: float | None, weight_decay: float
+) -> optax.GradientTransformation:
+    """Grad-clip -> L2 decay -> Adam moments. LR is applied by the caller.
+
+    Order matters and mirrors the reference stack: Lightning clips raw
+    gradients first (reference: train.py:172 `gradient_clip_val`), then torch
+    Adam adds ``weight_decay * param`` to the (clipped) gradient before the
+    moment updates.
+    """
+    parts = []
+    if gradient_clip_val is not None and gradient_clip_val > 0:
+        parts.append(optax.clip_by_global_norm(gradient_clip_val))
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.scale_by_adam())
+    # Ascent direction out; the train step multiplies by -lr.
+    return optax.chain(*parts)
+
+
+class PlateauScheduler:
+    """Host-side ReduceLROnPlateau with torch default semantics.
+
+    (reference: src/model.py:156-172 — factor 0.5, patience 2, mode 'min',
+    and torch defaults threshold=1e-4 in 'rel' mode, cooldown 0, min_lr 0.)
+    Stateful, val-metric-driven control flow lives outside jit by design
+    (SURVEY.md §7 hard parts).
+    """
+
+    def __init__(
+        self,
+        init_lr: float,
+        factor: float = 0.5,
+        patience: int = 2,
+        threshold: float = 1e-4,
+        min_lr: float = 0.0,
+    ):
+        self.lr = float(init_lr)
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.min_lr = min_lr
+        self.best = math.inf
+        self.num_bad_epochs = 0
+
+    def step(self, metric: float) -> float:
+        """Record one monitored value; returns the (possibly reduced) LR."""
+        metric = float(metric)
+        if metric < self.best * (1.0 - self.threshold):
+            self.best = metric
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            self.lr = max(self.lr * self.factor, self.min_lr)
+            self.num_bad_epochs = 0
+        return self.lr
+
+    def state_dict(self) -> dict:
+        return {
+            "lr": self.lr,
+            "best": self.best,
+            "num_bad_epochs": self.num_bad_epochs,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.lr = state["lr"]
+        self.best = state["best"]
+        self.num_bad_epochs = state["num_bad_epochs"]

@@ -1,0 +1,270 @@
+"""Jitted, sharded epoch and evaluation programs.
+
+TPU-first replacement for the reference's hot loop (reference: Lightning's
+fit loop dispatching ``training_step`` per batch, src/model.py:204/251/308,
+with host->GPU copies per step through DataLoader workers). Here:
+
+- The ENTIRE train split lives in HBM, sharded over the mesh's data axis.
+- One epoch is ONE XLA program: ``shard_map`` over the mesh, ``lax.scan``
+  over steps; each step gathers its (pre-permuted) batch locally, computes
+  grads, ``pmean``s them over ICI, and applies the Adam update. Zero host
+  round-trips inside an epoch — this is where the steps/sec/chip win over
+  the reference's per-step Python dispatch comes from.
+- Evaluation is likewise one program: scan over chunks, masked metric sums,
+  one ``psum`` at the end (the TPU-native form of torchmetrics'
+  ``dist_reduce_fx="sum"``, reference: src/model.py:24-25).
+
+All factories below close over static configuration and return functions
+ready for ``jax.jit``; batch shapes are static so each (model, shape) pair
+compiles exactly once per process.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from masters_thesis_tpu.data.pipeline import Batch
+from masters_thesis_tpu.models.objectives import (
+    WindowObjective,
+    batched_objective,
+    mse_window,
+    nll_window,
+)
+from masters_thesis_tpu.parallel import DATA_AXIS
+
+
+def forward_rows(module, params, x, dropout_rng=None):
+    """Apply the encoder to a window batch: ``(B, K, T, F) -> (B, K, 1)`` x2.
+
+    Flattens (batch, stocks) into rows exactly like the reference's
+    ``flatten(0, 1)`` step preamble (reference: src/model.py:120-123).
+    """
+    b, k = x.shape[:2]
+    rows = x.reshape(b * k, *x.shape[2:])
+    deterministic = dropout_rng is None
+    rngs = None if deterministic else {"dropout": dropout_rng}
+    alpha, beta = module.apply(
+        {"params": params}, rows, deterministic=deterministic, rngs=rngs
+    )
+    return alpha.reshape(b, k, 1), beta.reshape(b, k, 1)
+
+
+def _accumulate(sums: dict, new: dict) -> dict:
+    return {k: (sums[k][0] + new[k][0], sums[k][1] + new[k][1]) for k in sums}
+
+
+def _zero_sums(keys) -> dict:
+    return {k: (jnp.zeros(()), jnp.zeros(())) for k in keys}
+
+
+def metric_means(sums: dict) -> dict:
+    """Host-side: turn psum'd (value_sum, weight) pairs into means."""
+    return {k: float(v) / max(float(w), 1e-30) for k, (v, w) in sums.items()}
+
+
+# ------------------------------------------------------------------- train
+
+
+def _make_loss_fn(module, window_objective: WindowObjective):
+    """(params, dropout rng, batch) -> (mean loss, metric sums incl 'total')."""
+    batched = batched_objective(window_objective)
+
+    def loss_fn(params, step_rng, batch: Batch):
+        alpha, beta = forward_rows(module, params, batch.x, dropout_rng=step_rng)
+        return batched(alpha, beta, batch.y, batch.factor, batch.inv_psi)
+
+    return loss_fn
+
+
+def make_train_epoch(
+    module,
+    window_objective: WindowObjective,
+    metric_keys: tuple,
+    tx,
+    mesh: Mesh,
+) -> Callable:
+    """Build the one-epoch program.
+
+    Returned signature (all device values)::
+
+        epoch_fn(params, opt_state, lr, rng, data, idx)
+            -> (params, opt_state, metric_sums)
+
+    where ``data`` is the full train split sharded on its window axis
+    (``P('data')``), and ``idx`` is an int32 ``(steps, global_batch)`` array
+    sharded on axis 1 whose entries are LOCAL window indices for the owning
+    device (the host builds a per-device permutation each epoch — shuffling
+    stays shard-local so the gather never crosses ICI).
+    """
+
+    loss_fn = _make_loss_fn(module, window_objective)
+
+    def local_epoch(params, opt_state, lr, rng, data: Batch, idx):
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        n_steps = idx.shape[0]
+
+        def step(carry, inp):
+            params, opt_state, sums = carry
+            i, batch_idx = inp
+            step_rng = jax.random.fold_in(rng, i)
+            batch = Batch(
+                *(jnp.take(a, batch_idx, axis=0) for a in data)
+            )
+            (_, step_sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, step_rng, batch
+            )
+            # Equal per-device batch sizes => pmean of local-mean grads is
+            # the global-batch gradient (the DDP all-reduce, on ICI).
+            grads = lax.pmean(grads, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p - lr * u.astype(p.dtype), params, updates
+            )
+            sums = _accumulate(sums, step_sums)
+            return (params, opt_state, sums), None
+
+        zero = _zero_sums(tuple(metric_keys) + ("total",))
+        (params, opt_state, sums), _ = lax.scan(
+            step, (params, opt_state, zero), (jnp.arange(n_steps), idx)
+        )
+        sums = lax.psum(sums, DATA_AXIS)
+        return params, opt_state, sums
+
+    data_spec = Batch(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+    sharded = jax.shard_map(
+        local_epoch,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), data_spec, P(None, DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def make_train_step(
+    module,
+    window_objective: WindowObjective,
+    tx,
+    mesh: Mesh,
+) -> Callable:
+    """Per-batch jitted update for the ``stream`` epoch mode.
+
+    Unlike :func:`make_train_epoch` this is the pjit path: the batch arrives
+    sharded on its window axis (the prefetcher places it), params arrive
+    replicated, and XLA's sharding propagation inserts the gradient
+    all-reduce — no explicit collectives in user code.
+    """
+    from jax.sharding import NamedSharding
+
+    loss_fn = _make_loss_fn(module, window_objective)
+
+    def step_fn(params, opt_state, lr, rng, batch: Batch):
+        (_, sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, rng, batch
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p - lr * u.astype(p.dtype), params, updates
+        )
+        return params, opt_state, sums
+
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    batch_sh = Batch(shard, shard, shard, shard)
+    return jax.jit(
+        step_fn,
+        donate_argnums=(0, 1),
+        in_shardings=(repl, repl, repl, repl, batch_sh),
+        out_shardings=(repl, repl, repl),
+    )
+
+
+# -------------------------------------------------------------------- eval
+
+
+def window_eval_metrics(alpha, beta, y, factor, inv_psi) -> dict:
+    """Per-window evaluation metrics: objective components + test-path MAE.
+
+    Mirrors the reference's ``test_step`` (reference: src/model.py:119-141):
+    MAE of ``alpha + beta * r_market`` against realized returns, plus the
+    Gaussian NLL under the Woodbury inverse covariance, plus plain MSE.
+    """
+    r_target = y[:, :, 0]
+    r_market = y[:, :, 1]
+    r_pred = alpha + beta * r_market
+    n = jnp.float32(r_target.size)
+    mse_loss, _ = mse_window(alpha, beta, y, factor, inv_psi)
+    nll_loss, _ = nll_window(alpha, beta, y, factor, inv_psi)
+    mae = jnp.mean(jnp.abs(r_pred - r_target))
+    return {
+        "mse": (mse_loss * n, n),
+        "nll": (nll_loss, jnp.float32(1.0)),
+        "mae": (mae * n, n),
+    }
+
+
+def make_eval_fn(
+    module,
+    window_objective: WindowObjective,
+    mesh: Mesh,
+) -> Callable:
+    """Build the one-pass evaluation program.
+
+    Returned signature::
+
+        eval_fn(params, data, mask) -> metric_sums
+
+    ``data`` leaves are shaped ``(steps, n_dev * chunk, ...)`` sharded on
+    axis 1; ``mask`` is ``(steps, n_dev * chunk)`` with 0 marking padding
+    windows (splits rarely divide evenly — masked sums keep the means
+    exact, unlike silently dropping or double-counting remainder windows).
+    """
+
+    def window_fn(alpha, beta, y, factor, inv_psi):
+        loss, _ = window_objective(alpha, beta, y, factor, inv_psi)
+        metrics = window_eval_metrics(alpha, beta, y, factor, inv_psi)
+        metrics["total"] = (loss, jnp.float32(1.0))
+        return metrics
+
+    def local_eval(params, data: Batch, mask):
+        def step(sums, inp):
+            batch, m = inp
+            alpha, beta = forward_rows(module, params, batch.x)
+            metrics = jax.vmap(window_fn)(
+                alpha, beta, batch.y, batch.factor, batch.inv_psi
+            )
+            # where(), not multiply: padded windows have singular factor
+            # stats, so their metric values are NaN and NaN*0 == NaN.
+            masked = {
+                k: (
+                    jnp.sum(jnp.where(m > 0, v, 0.0)),
+                    jnp.sum(jnp.where(m > 0, w, 0.0)),
+                )
+                for k, (v, w) in metrics.items()
+            }
+            sums = _accumulate(sums, masked) if sums else masked
+            return sums, None
+
+        zero = _zero_sums(("mse", "nll", "mae", "total"))
+        sums, _ = lax.scan(step, zero, (data, mask))
+        return lax.psum(sums, DATA_AXIS)
+
+    data_spec = Batch(
+        P(None, DATA_AXIS),
+        P(None, DATA_AXIS),
+        P(None, DATA_AXIS),
+        P(None, DATA_AXIS),
+    )
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), data_spec, P(None, DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

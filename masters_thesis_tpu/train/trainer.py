@@ -1,0 +1,386 @@
+"""The fit/test orchestration loop — Lightning's Trainer, TPU-native.
+
+(reference: train.py:169-198 constructs Trainer(max_epochs,
+gradient_clip_val, precision, check_val_every_n_epoch, ...) then fit + test.)
+
+Two execution strategies, one code path:
+
+- ``single_device`` — a 1-device mesh; psum/pmean degenerate to no-ops.
+- ``tpu_xla`` — the full mesh over all visible chips; batch axis sharded,
+  grads pmean'd over ICI (BASELINE.json: "pjit + lax.psum over ICI").
+  ``auto`` picks tpu_xla iff >1 device is visible.
+
+Two epoch modes:
+
+- ``scan`` (default): the train split is device-resident and each epoch is
+  one jitted shard_map+scan program (see steps.py) — the fast path.
+- ``stream``: host batch iterator + double-buffered ``device_put`` prefetch
+  with a per-step jitted update — the reference-shaped loop, kept for
+  datasets that outgrow HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from masters_thesis_tpu.data.pipeline import Batch, FinancialWindowDataModule
+from masters_thesis_tpu.data.prefetch import prefetch_to_device
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.parallel import (
+    DATA_AXIS,
+    batch_sharding,
+    make_data_mesh,
+)
+from masters_thesis_tpu.train import checkpoint as ckpt_lib
+from masters_thesis_tpu.train.logging import TensorBoardLogger
+from masters_thesis_tpu.train.optim import PlateauScheduler, make_optimizer
+from masters_thesis_tpu.train.steps import (
+    make_eval_fn,
+    make_train_epoch,
+    make_train_step,
+    metric_means,
+)
+
+EVAL_CHUNK = 32
+
+
+@dataclasses.dataclass
+class TrainResult:
+    params: Any
+    opt_state: Any
+    best_val_loss: float
+    history: list[dict]
+    steps_per_sec: float
+    test_metrics: dict | None = None
+
+
+def _precision_dtype(precision: str):
+    if precision in ("32-true", "32", "fp32"):
+        return jnp.float32
+    if precision in ("bf16-mixed", "bf16"):
+        return jnp.bfloat16
+    raise ValueError(f"unknown precision: {precision!r}")
+
+
+class Trainer:
+    def __init__(
+        self,
+        max_epochs: int,
+        gradient_clip_val: float | None = None,
+        precision: str = "32-true",
+        check_val_every_n_epoch: int = 1,
+        strategy: str = "auto",
+        epoch_mode: str = "scan",
+        n_devices: int | None = None,
+        enable_progress_bar: bool = True,
+        enable_model_summary: bool = True,
+        profile: bool = False,
+        logger: TensorBoardLogger | None = None,
+        ckpt_dir: str | Path | None = None,
+        seed: int = 0,
+        name: str = "fast",
+    ):
+        self.max_epochs = max_epochs
+        self.gradient_clip_val = gradient_clip_val
+        self.compute_dtype = _precision_dtype(precision)
+        self.check_val_every_n_epoch = max(1, int(check_val_every_n_epoch))
+        if strategy == "auto":
+            strategy = "tpu_xla" if len(jax.devices()) > 1 else "single_device"
+        self.strategy = strategy
+        self.epoch_mode = epoch_mode
+        self.mesh = make_data_mesh(
+            1 if strategy == "single_device" else n_devices
+        )
+        self.n_dev = self.mesh.size
+        self.enable_progress_bar = enable_progress_bar
+        self.enable_model_summary = enable_model_summary
+        self.profile = profile
+        self.logger = logger
+        self.ckpt_dir = Path(ckpt_dir) if ckpt_dir else None
+        self.seed = seed
+        self.name = name
+
+    # ----------------------------------------------------------- data prep
+
+    def _device_train_split(self, arrays: Batch) -> tuple[Batch, int]:
+        """Shard the train split over the mesh; returns (device batch, n_local).
+
+        Truncates to a multiple of the mesh size (<= n_dev-1 windows dropped;
+        every window still rotates in via the per-epoch shard-local shuffle
+        being re-drawn — matches DDP sampler semantics).
+        """
+        n = arrays.x.shape[0]
+        n_local = n // self.n_dev
+        if n_local == 0:
+            raise ValueError(
+                f"train split has {n} windows < mesh size {self.n_dev}"
+            )
+        trunc = jax.tree_util.tree_map(
+            lambda a: a[: n_local * self.n_dev], arrays
+        )
+        return (
+            jax.device_put(trunc, batch_sharding(self.mesh)),
+            n_local,
+        )
+
+    def _epoch_indices(self, n_local: int, b_local: int, epoch: int) -> jax.Array:
+        """Per-device local permutations, stacked to (steps, global_batch)."""
+        steps = n_local // b_local
+        blocks = []
+        for d in range(self.n_dev):
+            rng = np.random.default_rng((self.seed, epoch, d))
+            perm = rng.permutation(n_local)[: steps * b_local]
+            blocks.append(perm.reshape(steps, b_local))
+        idx = np.concatenate(blocks, axis=1).astype(np.int32)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            idx, NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
+        )
+
+    def _eval_split(self, arrays: Batch) -> tuple[Batch, jax.Array] | None:
+        """Pad + reshape a split to (steps, n_dev*chunk, ...) with a mask."""
+        n = arrays.x.shape[0]
+        if n == 0:
+            return None
+        global_chunk = self.n_dev * min(EVAL_CHUNK, max(1, n // self.n_dev))
+        steps = -(-n // global_chunk)
+        padded = steps * global_chunk
+
+        def pad_reshape(a):
+            a = np.asarray(a)
+            widths = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, widths).reshape(steps, global_chunk, *a.shape[1:])
+
+        mask = np.zeros((padded,), np.float32)
+        mask[:n] = 1.0
+        mask = mask.reshape(steps, global_chunk)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
+        batch = jax.device_put(
+            jax.tree_util.tree_map(pad_reshape, arrays), sharding
+        )
+        return batch, jax.device_put(mask, sharding)
+
+    # ----------------------------------------------------------------- fit
+
+    def fit(
+        self,
+        spec: ModelSpec,
+        dm: FinancialWindowDataModule,
+        init_state: tuple[Any, Any] | None = None,
+    ) -> TrainResult:
+        """Train; ``init_state=(params, opt_state)`` resumes from a
+        checkpoint (reference: train.py:187 passes ckpt_path to fit)."""
+        dm.prepare_data(verbose=self.enable_progress_bar)
+        dm.setup("fit")
+
+        module = spec.build_module(compute_dtype=self.compute_dtype)
+        init_rng, dropout_rng = jax.random.split(jax.random.key(self.seed))
+        dummy = jnp.zeros(
+            (1, dm.lookback_window, dm.n_features), jnp.float32
+        )
+        params = module.init(init_rng, dummy)["params"]
+        if self.enable_model_summary:
+            n_params = sum(
+                p.size for p in jax.tree_util.tree_leaves(params)
+            )
+            self._print(f"model: {spec.objective} | params: {n_params:,} "
+                        f"| mesh: {self.n_dev}x{DATA_AXIS} | {self.strategy}")
+
+        tx = make_optimizer(self.gradient_clip_val, spec.weight_decay)
+        opt_state = tx.init(params)
+        if init_state is not None:
+            from masters_thesis_tpu.parallel import replicated_sharding
+            from masters_thesis_tpu.train.checkpoint import restore_opt_state
+
+            repl = replicated_sharding(self.mesh)
+            params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, init_state[0]), repl
+            )
+            opt_state = jax.device_put(
+                restore_opt_state(jax.device_get(opt_state), init_state[1]),
+                repl,
+            )
+        scheduler = PlateauScheduler(spec.learning_rate)
+        objective = spec.window_objective()
+
+        val_prepared = self._eval_split(dm.val_arrays())
+        eval_fn = make_eval_fn(module, objective, self.mesh)
+
+        if self.epoch_mode == "scan":
+            train_dev, n_local = self._device_train_split(dm.train_arrays())
+            b_local = dm.batch_size
+            steps_per_epoch = n_local // b_local
+            epoch_fn = make_train_epoch(
+                module, objective, spec.metric_keys, tx, self.mesh
+            )
+
+            def run_epoch(params, opt_state, lr, epoch_rng, epoch):
+                idx = self._epoch_indices(n_local, b_local, epoch)
+                return epoch_fn(params, opt_state, lr, epoch_rng, train_dev, idx)
+
+        elif self.epoch_mode == "stream":
+            global_b = dm.batch_size * self.n_dev
+            n_train = len(dm.train_range)
+            steps_per_epoch = n_train // global_b
+            if steps_per_epoch == 0:
+                raise ValueError(
+                    f"train split has {n_train} windows < one global batch "
+                    f"({dm.batch_size} x {self.n_dev} devices)"
+                )
+            step_fn = make_train_step(module, objective, tx, self.mesh)
+            shard = batch_sharding(self.mesh)
+
+            def run_epoch(params, opt_state, lr, epoch_rng, epoch):
+                sums = None
+                it = dm._iterate(
+                    dm.train_range, global_b, shuffle_seed=(self.seed, epoch)
+                )
+                full = (b for b in it if b.x.shape[0] == global_b)
+                for i, batch in enumerate(
+                    prefetch_to_device(full, sharding=shard)
+                ):
+                    step_rng = jax.random.fold_in(epoch_rng, i)
+                    params, opt_state, step_sums = step_fn(
+                        params, opt_state, lr, step_rng, batch
+                    )
+                    sums = (
+                        step_sums
+                        if sums is None
+                        else jax.tree_util.tree_map(jnp.add, sums, step_sums)
+                    )
+                return params, opt_state, sums
+
+        else:
+            raise ValueError(f"unknown epoch_mode: {self.epoch_mode!r}")
+
+        history: list[dict] = []
+        best_val = float("inf")
+        total_steps = 0
+        t_start = None  # set after first epoch (excludes compile)
+
+        for epoch in range(self.max_epochs):
+            if self.profile and epoch == 1:
+                jax.profiler.start_trace(
+                    str((self.logger.log_dir if self.logger else Path("logs"))
+                        / "profile")
+                )
+            epoch_rng = jax.random.fold_in(dropout_rng, epoch)
+            lr = jnp.float32(scheduler.lr)
+            params, opt_state, sums = run_epoch(
+                params, opt_state, lr, epoch_rng, epoch
+            )
+            train_metrics = metric_means(jax.device_get(sums))
+            total_steps += steps_per_epoch
+            if epoch == 0:
+                jax.block_until_ready(params)
+                t_start = time.perf_counter()
+
+            row = {"epoch": epoch, "lr": scheduler.lr}
+            row.update({f"loss/{k}/train": v for k, v in train_metrics.items()})
+
+            if (epoch + 1) % self.check_val_every_n_epoch == 0 and val_prepared:
+                val_sums = eval_fn(params, *val_prepared)
+                val_metrics = metric_means(jax.device_get(val_sums))
+                row.update({f"loss/{k}/val": v for k, v in val_metrics.items()})
+                val_loss = val_metrics["total"]
+                new_lr = scheduler.step(val_loss)
+                row["lr"] = new_lr
+                if val_loss < best_val:
+                    best_val = val_loss
+                    self._save("best", params, opt_state, spec, epoch, val_loss, dm)
+                self._save("last", params, opt_state, spec, epoch, val_loss, dm)
+
+            if self.logger:
+                self.logger.log_scalars(
+                    {k: v for k, v in row.items() if k != "epoch"}, epoch
+                )
+            history.append(row)
+            if self.profile and epoch == 1:
+                jax.block_until_ready(params)
+                jax.profiler.stop_trace()
+            self._print(
+                f"epoch {epoch:4d} | "
+                + " | ".join(
+                    f"{k.split('/')[1]}/{k.split('/')[2]} {v:.5g}"
+                    for k, v in row.items()
+                    if k.startswith("loss/")
+                )
+            )
+
+        jax.block_until_ready(params)
+        elapsed = time.perf_counter() - (t_start or time.perf_counter())
+        post_compile_steps = total_steps - steps_per_epoch
+        steps_per_sec = (
+            post_compile_steps / elapsed if elapsed > 0 and post_compile_steps else 0.0
+        )
+
+        # 'last' must hold the FINAL params even when the last epoch wasn't a
+        # val epoch (Lightning's save_last=True, train.py:159).
+        if self.ckpt_dir:
+            self._save("last", params, opt_state, spec, self.max_epochs - 1,
+                       best_val, dm)
+
+        return TrainResult(
+            params=params,
+            opt_state=opt_state,
+            best_val_loss=best_val,
+            history=history,
+            steps_per_sec=steps_per_sec,
+        )
+
+    # ---------------------------------------------------------------- test
+
+    def test(
+        self, spec: ModelSpec, params: Any, dm: FinancialWindowDataModule
+    ) -> dict:
+        """Final test metrics: MAE + NLL + MSE + objective total
+        (reference: trainer.test at train.py:198 -> src/model.py:119-141)."""
+        dm.setup("test")
+        module = spec.build_module(compute_dtype=self.compute_dtype)
+        eval_fn = make_eval_fn(module, spec.window_objective(), self.mesh)
+        prepared = self._eval_split(dm.test_arrays())
+        if prepared is None:
+            return {}
+        sums = eval_fn(params, *prepared)
+        metrics = metric_means(jax.device_get(sums))
+        if self.logger:
+            self.logger.log_scalars(
+                {f"test/{k}": v for k, v in metrics.items()}, 0
+            )
+        return metrics
+
+    # ------------------------------------------------------------- helpers
+
+    def _save(self, tag, params, opt_state, spec, epoch, val_loss, dm):
+        if not self.ckpt_dir:
+            return
+        ckpt_lib.save_checkpoint(
+            self.ckpt_dir, tag, params, opt_state, spec,
+            meta={
+                "epoch": epoch,
+                "val_loss": float(val_loss),
+                "trainer": self.name,
+                "datamodule": {
+                    "lookback_window": dm.lookback_window,
+                    "target_window": dm.target_window,
+                    "stride": dm.stride,
+                    "prediction_task": dm.prediction_task,
+                    "interaction_only": dm.interaction_only,
+                    "batch_size": dm.batch_size,
+                },
+            },
+        )
+
+    def _print(self, msg: str) -> None:
+        if self.enable_progress_bar and jax.process_index() == 0:
+            print(msg, flush=True)

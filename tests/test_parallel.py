@@ -1,0 +1,64 @@
+"""Mesh/sharding helpers on the 8-device virtual CPU platform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from masters_thesis_tpu.parallel import (
+    DATA_AXIS,
+    batch_sharding,
+    make_data_mesh,
+    replicated_sharding,
+)
+
+
+def test_full_mesh():
+    mesh = make_data_mesh()
+    assert mesh.size == 8
+    assert mesh.axis_names == (DATA_AXIS,)
+
+
+def test_submesh():
+    assert make_data_mesh(2).size == 2
+    with pytest.raises(ValueError):
+        make_data_mesh(99)
+
+
+def test_batch_sharding_splits_leading_dim():
+    mesh = make_data_mesh()
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = jax.device_put(x, batch_sharding(mesh))
+    assert arr.sharding.spec == PartitionSpec(DATA_AXIS)
+    # each device holds 16/8 = 2 rows
+    shard_shapes = {s.data.shape for s in arr.addressable_shards}
+    assert shard_shapes == {(2, 3)}
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_replicated_sharding_copies_everywhere():
+    mesh = make_data_mesh()
+    x = jnp.ones((4, 4))
+    arr = jax.device_put(x, replicated_sharding(mesh))
+    assert len(arr.addressable_shards) == 8
+    assert all(s.data.shape == (4, 4) for s in arr.addressable_shards)
+
+
+def test_psum_over_mesh_matches_sum():
+    mesh = make_data_mesh()
+    x = np.arange(8.0, dtype=np.float32)
+
+    def local(v):
+        return jax.lax.psum(jnp.sum(v), DATA_AXIS)
+
+    total = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=PartitionSpec(DATA_AXIS),
+            out_specs=PartitionSpec(),
+            check_vma=False,
+        )
+    )(x)
+    assert float(total) == pytest.approx(x.sum())
