@@ -274,15 +274,33 @@ def expand_multirun(overrides: list[str]) -> list[list[str]]:
 
     ``["lr=1e-3,1e-4", "model=large"]`` -> two single-run override lists
     (reference: sweeps/example.sh drives Hydra ``-m`` the same way).
+    Commas inside brackets are value syntax (``dims=[16,32]``), not sweep
+    separators.
     """
     choice_lists: list[list[str]] = []
     for ov in overrides:
-        if "=" in ov and "," in ov.partition("=")[2]:
-            key, _, raw = ov.partition("=")
-            choice_lists.append([f"{key}={v}" for v in raw.split(",")])
+        key, eq, raw = ov.partition("=")
+        choices = _split_top_level(raw) if eq else [raw]
+        if len(choices) > 1:
+            choice_lists.append([f"{key}={v}" for v in choices])
         else:
             choice_lists.append([ov])
     return [list(combo) for combo in itertools.product(*choice_lists)]
+
+
+def _split_top_level(raw: str) -> list[str]:
+    """Split on commas not nested inside []/{}."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(raw):
+        if ch in "[{":
+            depth += 1
+        elif ch in "]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(raw[start:i])
+            start = i + 1
+    parts.append(raw[start:])
+    return parts
 
 
 def to_flat_dict(cfg: dict, prefix: str = "") -> dict[str, Any]:

@@ -1,0 +1,10 @@
+"""Matplotlib plot library for model-vs-OLS-vs-truth evaluation figures."""
+
+from masters_thesis_tpu.viz.plots import (
+    estimation_plots,
+    estimation_scatter,
+    hist_plot,
+    scatter_plot,
+)
+
+__all__ = ["scatter_plot", "hist_plot", "estimation_plots", "estimation_scatter"]

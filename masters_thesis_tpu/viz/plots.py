@@ -1,0 +1,111 @@
+"""Evaluation figures: model vs OLS vs ground truth.
+
+Behavioral parity with the reference plot library (reference:
+src/plots.py:10-110): same four figure kinds, same statistical annotations
+(Pearson correlation in titles, mean/std vlines on histograms, identity
+reference lines), operating on plain numpy arrays instead of torch tensors.
+"""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")  # headless: figures only ever go to TensorBoard
+import matplotlib.pyplot as plt
+import numpy as np
+
+FIGSIZE = (16, 9)
+
+
+def _corr(a: np.ndarray, b: np.ndarray) -> float:
+    return float(np.corrcoef(a.ravel(), b.ravel())[0, 1])
+
+
+def scatter_plot(model: np.ndarray, ols: np.ndarray, title: str):
+    """Model-vs-OLS scatter with identity line; correlation in the title
+    (reference: src/plots.py:10-28)."""
+    model = np.asarray(model).ravel()
+    ols = np.asarray(ols).ravel()
+    fig, ax = plt.subplots(figsize=FIGSIZE)
+    ax.scatter(model, ols, marker=".")
+    identity = (model.min(), model.max())
+    ax.plot(identity, identity, "r--")
+    ax.set_xlabel("Model")
+    ax.set_ylabel("OLS")
+    ax.set_title(f"{title}, corr={_corr(model, ols):.4f}")
+    ax.grid(alpha=0.5)
+    return fig
+
+
+def hist_plot(model: np.ndarray, ols: np.ndarray, title: str):
+    """Overlaid density histograms with mean/std vlines; bin count scales as
+    1% of the sample count (reference: src/plots.py:30-54)."""
+    model = np.asarray(model).ravel()
+    ols = np.asarray(ols).ravel()
+    bins = int(len(model) * 0.01) + 1
+    fig, ax = plt.subplots(figsize=FIGSIZE)
+    ax.hist(model, bins=bins, density=True, alpha=0.6, label="Model", color="blue")
+    ax.hist(ols, bins=bins, density=True, alpha=0.6, label="OLS", color="orange")
+    for data, color, label in ((model, "blue", "Model"), (ols, "orange", "OLS")):
+        ax.axvline(
+            data.mean(),
+            color=color,
+            linestyle="--",
+            label=f"{label} Residual Avg: {data.mean():.4f} (std={data.std():.4f})",
+        )
+    ax.set_title(title)
+    ax.grid(alpha=0.5)
+    ax.legend()
+    return fig
+
+
+def estimation_plots(tb, model_ests, ols_ests, trues, est_kind: str = "alpha"):
+    """Per-stock estimate time-series, one TensorBoard figure per stock for
+    the first <=9 stocks (reference: src/plots.py:56-76 logs under
+    ``estimation/examples_<kind>`` keyed by global_step=stock index)."""
+    model_ests = np.asarray(model_ests)
+    ols_ests = np.asarray(ols_ests)
+    trues = np.asarray(trues)
+    for stock_idx in range(min(model_ests.shape[1], 9)):
+        fig, ax = plt.subplots(figsize=FIGSIZE)
+        sample = np.arange(model_ests.shape[0])
+        ax.plot(
+            sample,
+            trues[:, stock_idx],
+            color="magenta",
+            linestyle="--",
+            alpha=0.5,
+            label=f"True {est_kind}",
+        )
+        ax.scatter(sample, model_ests[:, stock_idx], marker=".", color="blue",
+                   label="Model")
+        ax.scatter(sample, ols_ests[:, stock_idx], marker=".", color="orange",
+                   label="OLS")
+        ax.set_title(f"Model vs OLS {est_kind} estimation (Stock {stock_idx})")
+        ax.legend()
+        ax.grid(alpha=0.5)
+        tb.log_figure(f"estimation/examples_{est_kind}", fig, step=stock_idx)
+        plt.close(fig)
+
+
+def estimation_scatter(model_ests, ols_ests, trues, est_kind: str = "alpha"):
+    """Two-panel truth-vs-estimate scatter (model top, OLS bottom), shared
+    axes, identity lines, per-panel correlation (reference:
+    src/plots.py:78-110)."""
+    model_ests = np.asarray(model_ests).ravel()
+    ols_ests = np.asarray(ols_ests).ravel()
+    trues = np.asarray(trues).ravel()
+    fig, (ax1, ax2) = plt.subplots(2, 1, figsize=FIGSIZE, sharex=True, sharey=True)
+    fig.suptitle(f"Ground Truth {est_kind} vs Estimated {est_kind}")
+    identity = (trues.min(), trues.max())
+    for ax, ests, color, label in (
+        (ax1, model_ests, "blue", "Model"),
+        (ax2, ols_ests, "orange", "OLS"),
+    ):
+        ax.set_title(f"{label} corr={_corr(ests, trues):.4f}")
+        ax.set_ylabel(f"{label} {est_kind}")
+        ax.plot(identity, identity, color="magenta", linestyle="--")
+        ax.scatter(trues, ests, marker=".", alpha=0.15, color=color)
+        ax.grid()
+    ax2.set_xlabel(f"Ground Truth {est_kind}")
+    return fig

@@ -1,0 +1,5 @@
+#!/bin/bash
+# 6-job example sweep (reference: sweeps/example.sh — same grid).
+python train.py -m datamodule=real model=large \
+    model.learning_rate=1e-3,1e-4,1e-5 \
+    trainer.max_epochs=100,200

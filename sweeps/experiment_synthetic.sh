@@ -1,0 +1,7 @@
+#!/bin/bash
+# 18-job synthetic experiment grid: 3 models x 3 losses x 2 trainers
+# (reference: sweeps/experiment_synthetic.sh — same grid).
+python train.py -m datamodule=synthetic \
+    model=small,medium,large \
+    loss=mse,nll,combined \
+    trainer=slow,slowest

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Evaluation/plot driver — loads a checkpoint, compares model vs OLS vs
+ground truth on the test split, and renders TensorBoard figures.
+
+Usage (reference: test.py:147-218)::
+
+    python test.py checkpoint=logs/FinancialLstm/synthetic/<version>/checkpoints
+
+Figure set and tags match the reference's ``plot`` (reference:
+test.py:91-145): residual scatter/hist pairs, per-stock estimation series,
+and truth-vs-estimate scatters for alpha and beta.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from train import CONFIG_DIR, build_datamodule, bootstrap
+from masters_thesis_tpu.config import Config, compose
+
+
+def derive_logger_dirs(checkpoint: Path, cfg: Config) -> tuple[str, str, str]:
+    """Recover (save_dir, name, version) from the checkpoint path layout
+    ``<save_dir>/<name...>/<version>/checkpoints[/tag]``
+    (reference: test.py:182-192 parses the same parts)."""
+    parts = list(Path(checkpoint).resolve().parts)
+    if "checkpoints" in parts:
+        i = parts.index("checkpoints")
+        version = parts[i - 1]
+        save_root = Path(cfg.logger.save_dir).resolve()
+        try:
+            # name = whatever sits between save_dir and version
+            rel = Path(*parts[: i - 1]).relative_to(save_root)
+            return str(save_root), str(rel), version
+        except ValueError:
+            pass
+    return cfg.logger.save_dir, cfg.logger.name, cfg.logger.version
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("overrides", nargs="*", help="key=value config overrides")
+    args = parser.parse_args(argv)
+    cfg = compose(str(CONFIG_DIR), overrides=args.overrides)
+
+    if not cfg.checkpoint:
+        # (reference: test.py:153 exits early with the same complaint)
+        print("No model checkpoint found, exiting...", file=sys.stderr)
+        return
+
+    from masters_thesis_tpu.evaluation import collect_test_results
+    from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+    from masters_thesis_tpu.train.logging import TensorBoardLogger
+    from masters_thesis_tpu.viz import (
+        estimation_plots,
+        estimation_scatter,
+        hist_plot,
+        scatter_plot,
+    )
+
+    params, _, spec, meta = restore_checkpoint(Path(cfg.checkpoint))
+    # Evaluate on the SAME windowing the checkpoint was trained with: the
+    # sidecar's datamodule hparams override the composed config (data_dir
+    # stays config-driven — it is environment-, not model-specific).
+    for key, value in meta.get("datamodule", {}).items():
+        if key in cfg.datamodule:
+            cfg.datamodule[key] = value
+    if not bootstrap(cfg):
+        return
+    dm = build_datamodule(cfg)
+    dm.prepare_data()
+
+    results = collect_test_results(spec, params, dm)
+
+    save_dir, name, version = derive_logger_dirs(Path(cfg.checkpoint), cfg)
+    tb = TensorBoardLogger(save_dir, name, version)
+
+    tb.log_figure(
+        "scatter/recon_residuals",
+        scatter_plot(
+            results["recon_residuals"]["model"],
+            results["recon_residuals"]["ols"],
+            title="Model vs OLS Reconstruction Residuals",
+        ),
+    )
+    tb.log_figure(
+        "scatter/alphas",
+        scatter_plot(
+            results["alpha"]["model"], results["alpha"]["ols"],
+            title="Model vs OLS Alphas",
+        ),
+    )
+    tb.log_figure(
+        "scatter/betas",
+        scatter_plot(
+            results["beta"]["model"], results["beta"]["ols"],
+            title="Model vs OLS Betas",
+        ),
+    )
+    tb.log_figure(
+        "hist/recon_residuals",
+        hist_plot(
+            results["recon_residuals"]["model"],
+            results["recon_residuals"]["ols"],
+            title="Model vs OLS Reconstruction Residuals",
+        ),
+    )
+    tb.log_figure(
+        "hist/alphas",
+        hist_plot(
+            results["alpha_residuals"]["model"],
+            results["alpha_residuals"]["ols"],
+            title="Model vs OLS Alpha Residuals",
+        ),
+    )
+    tb.log_figure(
+        "hist/betas",
+        hist_plot(
+            results["beta_residuals"]["model"],
+            results["beta_residuals"]["ols"],
+            title="Model vs OLS Beta Residuals",
+        ),
+    )
+    for kind in ("alpha", "beta"):
+        estimation_plots(
+            tb,
+            results[kind]["model"],
+            results[kind]["ols"],
+            results[kind]["true"],
+            est_kind=kind,
+        )
+        tb.log_figure(
+            f"estimation/{kind}",
+            estimation_scatter(
+                results[kind]["model"],
+                results[kind]["ols"],
+                results[kind]["true"],
+                est_kind=kind,
+            ),
+        )
+    tb.close()
+    print(f"figures written to {tb.log_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,13 @@ def test_multirun_single_run_passthrough():
     assert expand_multirun(["model=large"]) == [["model=large"]]
 
 
+def test_multirun_brackets_not_split():
+    # commas inside [] are value syntax, not sweep separators
+    runs = expand_multirun(["+model.dims=[16,32]", "model.learning_rate=1e-3,1e-4"])
+    assert len(runs) == 2
+    assert all(ov[0] == "+model.dims=[16,32]" for ov in runs)
+
+
 def test_interpolation_cycle_detected(tmp_path):
     (tmp_path / "config.yaml").write_text("a: ${b}\nb: ${a}\n")
     with pytest.raises(ValueError, match="cycle"):

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Training driver — CLI-compatible with the reference's Hydra entry point.
+
+Usage (reference: train.py + sweeps/*.sh)::
+
+    python train.py                               # defaults
+    python train.py model=large loss=nll          # group overrides
+    python train.py model.learning_rate=1e-3      # value overrides
+    python train.py -m model.learning_rate=1e-3,1e-4 trainer.max_epochs=100,200
+
+Capability parity with the reference driver (reference: train.py:70-220):
+data bootstrap, datamodule + model construction from config, TensorBoard
+logger with composed name/version, best/last checkpointing, LR monitoring,
+fit + test, final hparams logging, and returning the best validation score.
+The Lightning Trainer is replaced by the in-tree TPU trainer
+(masters_thesis_tpu.train.Trainer); the joblib multirun launcher by a
+process-per-job native launcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from masters_thesis_tpu.config import (
+    Config,
+    compose,
+    expand_multirun,
+    register_resolver,
+    to_flat_dict,
+)
+
+CONFIG_DIR = Path(__file__).resolve().parent / "configs"
+
+# Derived config: feature count from the interaction_only flag
+# (reference: train.py:39-42).
+register_resolver(
+    "input_size_from_interaction", lambda interaction: 3 if interaction else 5
+)
+
+
+def bootstrap(cfg: Config) -> bool:
+    """Materialize source arrays for the selected datamodule.
+
+    (reference: train.py:15-36 — import-time side effects there; explicit
+    and config-driven here.) Returns False if real CSVs are missing.
+    """
+    from masters_thesis_tpu.data.pipeline import bootstrap_real, bootstrap_synthetic
+
+    dmcfg = cfg.datamodule
+    if dmcfg.name == "synthetic":
+        bootstrap_synthetic(
+            Path(dmcfg.data_dir),
+            n_stocks=dmcfg.n_stocks,
+            n_samples=dmcfg.n_samples,
+            seed=cfg.seed,
+        )
+        return True
+    if not bootstrap_real(Path(dmcfg.raw_dir), Path(dmcfg.data_dir)):
+        print(
+            f"Real data CSVs not found under {dmcfg.raw_dir}; download the "
+            "Fama-French daily factors + 25 portfolios files first.",
+            file=sys.stderr,
+        )
+        return False
+    return True
+
+
+def build_datamodule(cfg: Config):
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+
+    d = cfg.datamodule
+    return FinancialWindowDataModule(
+        Path(d.data_dir),
+        lookback_window=d.lookback_window,
+        target_window=d.target_window,
+        stride=d.stride,
+        prediction_task=d.prediction_task,
+        interaction_only=d.interaction_only,
+        batch_size=d.batch_size,
+    )
+
+
+def build_spec(cfg: Config):
+    """Model registry lookup + hparams (reference: train.py:45-67,121-136)."""
+    from masters_thesis_tpu.models.objectives import get_model_spec
+
+    hparams = dict(
+        input_size=cfg.model.input_size,
+        hidden_size=cfg.model.hidden_size,
+        num_layers=cfg.model.num_layers,
+        dropout=cfg.model.dropout,
+        learning_rate=cfg.model.learning_rate,
+        weight_decay=cfg.model.weight_decay,
+    )
+    if "mse_weight" in cfg.loss:
+        hparams["mse_weight"] = cfg.loss.mse_weight
+    return get_model_spec(cfg.loss.module_class, **hparams)
+
+
+def run(cfg: Config) -> float:
+    """One training run; returns the best validation loss (the sweep
+    objective the reference returns at train.py:220)."""
+    from masters_thesis_tpu.train import Trainer
+    from masters_thesis_tpu.train.logging import TensorBoardLogger
+
+    if not bootstrap(cfg):
+        return float("inf")
+    dm = build_datamodule(cfg)
+    spec = build_spec(cfg)
+
+    logger = TensorBoardLogger(
+        cfg.logger.save_dir, cfg.logger.name, cfg.logger.version
+    )
+    ckpt_dir = logger.log_dir / "checkpoints"
+
+    t = cfg.trainer
+    trainer = Trainer(
+        max_epochs=t.max_epochs,
+        gradient_clip_val=t.gradient_clip_val,
+        precision=t.precision,
+        check_val_every_n_epoch=t.get("check_val_every_n_epoch", 1),
+        strategy=t.strategy,
+        epoch_mode=t.epoch_mode,
+        enable_progress_bar=t.enable_progress_bar,
+        enable_model_summary=t.enable_model_summary,
+        profile=t.get("profile", False),
+        logger=logger,
+        ckpt_dir=ckpt_dir,
+        seed=cfg.seed,
+        name=t.name,
+    )
+
+    init_state = None
+    if cfg.checkpoint:
+        from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+
+        params, opt_state, spec, _ = restore_checkpoint(Path(cfg.checkpoint))
+        init_state = (params, opt_state)
+
+    result = trainer.fit(spec, dm, init_state=init_state)
+    test_metrics = trainer.test(spec, result.params, dm)
+
+    # Final hparams + test metrics table (reference: train.py:204-211).
+    logger.log_hparams(
+        to_flat_dict(cfg),
+        {
+            "test/mae": test_metrics.get("mae", float("nan")),
+            "test/nll": test_metrics.get("nll", float("nan")),
+            "test/best_val_loss": result.best_val_loss,
+        },
+    )
+    logger.close()
+    print(
+        f"done: best_val={result.best_val_loss:.6g} "
+        f"test_mae={test_metrics.get('mae', float('nan')):.6g} "
+        f"test_nll={test_metrics.get('nll', float('nan')):.6g} "
+        f"steps/sec={result.steps_per_sec:.1f}"
+    )
+    return result.best_val_loss
+
+
+def _run_job(config_dir: str, overrides: list[str]) -> float:
+    """Top-level function so the process-pool launcher can pickle it."""
+    cfg = compose(config_dir, overrides=overrides)
+    return run(cfg)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("overrides", nargs="*", help="key=value config overrides")
+    parser.add_argument(
+        "-m", "--multirun", action="store_true",
+        help="expand comma-separated override values into a sweep",
+    )
+    args = parser.parse_args(argv)
+
+    if not args.multirun:
+        _run_job(str(CONFIG_DIR), args.overrides)
+        return
+
+    jobs = expand_multirun(args.overrides)
+    cfg0 = compose(str(CONFIG_DIR), overrides=jobs[0])
+    n_jobs = int(cfg0.launcher.get("n_jobs", 1))
+    print(f"multirun: {len(jobs)} jobs, n_jobs={n_jobs}")
+    if n_jobs == 1:
+        # Sequential jobs share this process (and its one TPU client).
+        for i, ov in enumerate(jobs):
+            print(f"--- job {i}: {ov}")
+            _run_job(str(CONFIG_DIR), ov)
+    else:
+        # Process-per-job, like the reference's joblib launcher
+        # (reference: configs/config.yaml:6,17-19).
+        import joblib
+
+        joblib.Parallel(n_jobs=n_jobs, verbose=10)(
+            joblib.delayed(_run_job)(str(CONFIG_DIR), ov) for ov in jobs
+        )
+
+
+if __name__ == "__main__":
+    main()
