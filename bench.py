@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark: train-step throughput per chip on the synthetic workload.
+
+Measures steps/sec/chip for the canonical benchmark configuration
+(BASELINE.json: "train.py steps/sec/chip (synthetic datamodule)"): the
+reference's synthetic datamodule shape — 100 stocks per window, 60-day
+lookback, 3 features, batch_size=1 window per optimizer step, model=small,
+loss=mse (reference: configs/datamodule/synthetic.yaml, configs/model/
+small.yaml) — run through the device-resident scan-epoch trainer on ONE
+chip.
+
+vs_baseline: the reference publishes no throughput numbers (SURVEY.md §6).
+The denominator used here is 200 steps/sec/chip — a deliberately generous
+ceiling estimate for the reference's per-step Python dispatch pipeline
+(Lightning training_step + DataLoader worker handoff + per-step CUDA launch
+costs >= ~5 ms/step at batch_size=1 regardless of GPU speed). Any value >1
+means this framework beats that ceiling.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+BASELINE_STEPS_PER_SEC = 200.0
+
+# Scaled-down sample count (100k vs the reference's 1M bootstrap) keeps the
+# bench wall-clock to a couple of minutes; per-step work is IDENTICAL to the
+# canonical workload (same window/stock/feature shapes, same model).
+N_STOCKS = 100
+N_SAMPLES = 100_000
+MEASURE_EPOCHS = 2
+
+
+def main() -> None:
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.train import Trainer
+
+    data_dir = Path(__file__).resolve().parent / "data" / "bench_synthetic"
+    bootstrap_synthetic(data_dir, n_stocks=N_STOCKS, n_samples=N_SAMPLES, seed=0)
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=60, target_window=30, stride=90, batch_size=1
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+
+    spec = ModelSpec(objective="mse")  # model=small defaults, loss=mse
+    trainer = Trainer(
+        max_epochs=1 + MEASURE_EPOCHS,  # epoch 0 absorbs compile
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=10_000,  # pure train throughput
+        strategy="single_device",
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+    t0 = time.perf_counter()
+    result = trainer.fit(spec, dm)
+    wall = time.perf_counter() - t0
+
+    value = result.steps_per_sec
+    print(
+        json.dumps(
+            {
+                "metric": "train_steps_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "steps/s",
+                "vs_baseline": round(value / BASELINE_STEPS_PER_SEC, 3),
+                "detail": {
+                    "windows_per_epoch": len(dm.train_range),
+                    "batch_size": 1,
+                    "measure_epochs": MEASURE_EPOCHS,
+                    "wall_s": round(wall, 1),
+                    "device": str(trainer.mesh.devices.ravel()[0].platform),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
