@@ -8,10 +8,10 @@ TPU-first design decisions:
 
 - Per layer, the input projection for ALL timesteps is computed as one large
   ``(B*T, in) @ (in, 4H)`` matmul before the time scan — that is the matmul
-  the MXU sees, batched and maximal. The ``lax.scan`` body then contains only
-  the ``(B, H) @ (H, 4H)`` recurrent matmul and fused elementwise gates
-  (cuDNN applies the same split; here XLA fuses the gate math into the scan
-  body automatically).
+  the MXU sees, batched and maximal. The time recurrence then runs through
+  the fused Pallas kernel (ops/lstm_kernel.py) on TPU — recurrent weight and
+  state resident in VMEM for the whole loop — or an equivalent ``lax.scan``
+  on other backends (``kernel_impl`` selects; both paths are parity-tested).
 - Gate layout, gate order (i, f, g, o), double bias (``b_ih + b_hh``), and
   uniform(-1/sqrt(H), 1/sqrt(H)) initialization all match ``torch.nn.LSTM``
   so reference-trained behavior is reproducible (cross-checked numerically in
@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from masters_thesis_tpu.ops.lstm_kernel import lstm_recurrence
+
 
 def _torch_lstm_init(scale: float):
     """uniform(-scale, scale) — torch.nn.LSTM/Linear reset_parameters."""
@@ -48,6 +50,7 @@ class LstmEncoder(nn.Module):
     num_layers: int = 2
     dropout: float = 0.2
     compute_dtype: Any = jnp.float32
+    kernel_impl: str = "auto"  # pallas | xla | interpret | auto
 
     @nn.compact
     def __call__(
@@ -83,23 +86,9 @@ class LstmEncoder(nn.Module):
 
             w_hh_t = w_hh.T.astype(self.compute_dtype)
 
-            def step(carry, xt):
-                h, c = carry
-                gates = xt + h @ w_hh_t
-                i, f, g, o = jnp.split(gates, 4, axis=-1)
-                i = jax.nn.sigmoid(i)
-                f = jax.nn.sigmoid(f)
-                g = jnp.tanh(g)
-                o = jax.nn.sigmoid(o)
-                c = f * c + i * g
-                h = o * jnp.tanh(c)
-                return (h, c), h
-
-            carry0 = (
-                jnp.zeros((batch, hidden), self.compute_dtype),
-                jnp.zeros((batch, hidden), self.compute_dtype),
+            hs = lstm_recurrence(
+                jnp.swapaxes(x_proj, 0, 1), w_hh_t, impl=self.kernel_impl
             )
-            _, hs = jax.lax.scan(step, carry0, jnp.swapaxes(x_proj, 0, 1))
             outputs = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
 
             # torch applies inter-layer dropout to every layer except the

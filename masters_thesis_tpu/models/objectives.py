@@ -118,6 +118,7 @@ class ModelSpec:
     learning_rate: float = 1e-4
     weight_decay: float = 1e-5
     mse_weight: float = 1e2
+    kernel_impl: str = "auto"  # LSTM recurrence: pallas | xla | interpret
 
     def build_module(self, compute_dtype=jnp.float32):
         from masters_thesis_tpu.models.lstm import LstmEncoder
@@ -127,6 +128,7 @@ class ModelSpec:
             num_layers=self.num_layers,
             dropout=self.dropout,
             compute_dtype=compute_dtype,
+            kernel_impl=self.kernel_impl,
         )
 
     @property

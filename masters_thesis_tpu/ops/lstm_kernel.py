@@ -1,0 +1,307 @@
+"""Fused Pallas TPU kernel for the LSTM recurrence — the hot op.
+
+The reference leans on the cuDNN fused LSTM kernel for its hot loop
+(reference: src/model.py:104, ``torch.nn.LSTM``). The TPU-native analog here
+follows the same split cuDNN uses: the input projection for all timesteps is
+one large MXU matmul (done OUTSIDE this kernel, where XLA already emits an
+optimal batched dot), while the inherently sequential part — the per-timestep
+recurrent matmul plus gate math — is fused into a single Pallas kernel:
+
+- Hidden/cell state and the recurrent weight live in VMEM for the entire
+  time loop; nothing round-trips to HBM between timesteps, and the per-step
+  loop overhead is a hardware loop, not 60 unrolled XLA dynamic-slices.
+- Each step is one ``(B_tile, H) @ (H, 4H)`` MXU matmul with the sigmoid/
+  tanh gate math fused on the VPU, writing ``h_t`` straight into the VMEM
+  output block.
+- Training needs gradients, and Pallas kernels don't autodiff through
+  in-kernel loops — so the backward pass (standard BPTT) is a second fused
+  kernel wired via ``jax.custom_vjp``. Instead of stashing gate activations
+  like cuDNN, the backward kernel RECOMPUTES them from the saved ``h``/``c``
+  and the input projections (one extra MXU matmul per step) — that drops the
+  ``(T, B, 4H)`` stash, which is what lets a whole ~100-row batch (the
+  reference's 100-stock window) fit in VMEM as ONE program instead of
+  serialized row tiles.
+- When the batch does fit in one program, the backward kernel additionally
+  writes ``dx`` in place over the input-projection buffer
+  (``input_output_aliases``): the sweep runs t = T-1 → 0 and slot ``t`` is
+  dead after step ``t``, so the overwrite is hazard-free and saves another
+  ``(T, B, 4H)`` of VMEM. Larger batches fall back to a row-tiled grid
+  (rows are independent) with per-tile partial ``dw`` summed outside.
+
+Everything is time-major ``(T, B, ...)``: each timestep slice is then a
+contiguous ``(rows, lanes)`` tile, matching the TPU's (8, 128) layout.
+
+On non-TPU backends ``lstm_recurrence`` falls back to an identical
+``lax.scan`` formulation; tests additionally run the Pallas kernels in
+interpreter mode on CPU to pin parity between the two paths.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Single-program threshold / fallback row tile. ~104 rows keeps the aliased
+# backward under ~12 MB of VMEM at the reference's largest shape (T=60,
+# H=64); the tiled fallback uses 32-row blocks (double-buffered by the grid
+# pipeline, so its budget is ~2x per-block bytes).
+SINGLE_TILE_MAX_ROWS = 104
+ROW_TILE = 32
+
+
+def _pad_rows(a: jax.Array, b_pad: int) -> jax.Array:
+    b = a.shape[1]
+    if b == b_pad:
+        return a
+    return jnp.pad(a, ((0, 0), (0, b_pad - b), (0, 0)))
+
+
+def _row_tile(b: int) -> int:
+    b_pad8 = -(-b // 8) * 8
+    if b_pad8 <= SINGLE_TILE_MAX_ROWS:
+        return b_pad8
+    return ROW_TILE
+
+
+def _gate_math(gates):
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    return jax.nn.sigmoid(i), jax.nn.sigmoid(f), jnp.tanh(g), jax.nn.sigmoid(o)
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _fwd_kernel(x_ref, w_ref, h_out, c_out, h_scr, c_scr):
+    n_t = x_ref.shape[0]
+    h_scr[:] = jnp.zeros_like(h_scr)
+    c_scr[:] = jnp.zeros_like(c_scr)
+    w = w_ref[:].astype(jnp.float32)
+
+    def body(t, _):
+        gates = x_ref[t].astype(jnp.float32) + lax.dot_general(
+            h_scr[:],
+            w,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        i, f, g, o = _gate_math(gates)
+        c = f * c_scr[:] + i * g
+        h = o * jnp.tanh(c)
+        h_scr[:] = h
+        c_scr[:] = c
+        h_out[t] = h.astype(h_out.dtype)
+        c_out[t] = c.astype(c_out.dtype)
+        return 0
+
+    lax.fori_loop(0, n_t, body, 0)
+
+
+def _fwd_pallas(x_proj, w_hh_t, *, interpret):
+    n_t, b, four_h = x_proj.shape
+    hidden = four_h // 4
+    tile = _row_tile(b)
+    b_pad = -(-b // tile) * tile
+    x_padded = _pad_rows(x_proj, b_pad)
+    grid = (b_pad // tile,)
+
+    row_block = lambda width: pl.BlockSpec(  # noqa: E731
+        (n_t, tile, width), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+    )
+    hs, cs = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            row_block(four_h),
+            pl.BlockSpec(
+                (hidden, four_h), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[row_block(hidden), row_block(hidden)],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_t, b_pad, hidden), x_proj.dtype),
+            jax.ShapeDtypeStruct((n_t, b_pad, hidden), x_proj.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile, hidden), jnp.float32),
+            pltpu.VMEM((tile, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x_padded, w_hh_t)
+    return hs[:, :b], (x_padded, hs, cs, w_hh_t, b)
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _bwd_kernel(
+    dh_ref, x_ref, h_ref, c_ref, w_ref, dx_out, dw_out, dh_scr, dc_scr, dw_scr
+):
+    n_t = dh_ref.shape[0]
+    dh_scr[:] = jnp.zeros_like(dh_scr)
+    dc_scr[:] = jnp.zeros_like(dc_scr)
+    dw_scr[:] = jnp.zeros_like(dw_scr)
+    w = w_ref[:].astype(jnp.float32)
+
+    def body(k, _):
+        t = n_t - 1 - k
+        t_prev = jnp.maximum(t - 1, 0)
+        not_first = jnp.float32(1.0) - (t == 0).astype(jnp.float32)
+        c_prev = c_ref[t_prev].astype(jnp.float32) * not_first
+        h_prev = h_ref[t_prev].astype(jnp.float32) * not_first
+        # Recompute the activated gates (cheaper in VMEM than stashing them).
+        gates = x_ref[t].astype(jnp.float32) + lax.dot_general(
+            h_prev, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        i, f, g, o = _gate_math(gates)
+        tanh_c = jnp.tanh(c_ref[t].astype(jnp.float32))
+
+        dh = dh_ref[t].astype(jnp.float32) + dh_scr[:]
+        do = dh * tanh_c
+        dc = dh * o * (1.0 - tanh_c * tanh_c) + dc_scr[:]
+        di = dc * g
+        dg = dc * i
+        df = dc * c_prev
+        dc_scr[:] = dc * f
+        d_pre = jnp.concatenate(
+            [
+                di * i * (1.0 - i),
+                df * f * (1.0 - f),
+                dg * (1.0 - g * g),
+                do * o * (1.0 - o),
+            ],
+            axis=-1,
+        )
+        # Slot t of the (aliased) input buffer is dead from here on.
+        dx_out[t] = d_pre.astype(dx_out.dtype)
+        # d h_{t-1} = d_pre @ w_hh_tᵀ : contract the 4H axes.
+        dh_scr[:] = lax.dot_general(
+            d_pre, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # d w_hh_t += h_{t-1}ᵀ @ d_pre : contract the row axes.
+        dw_scr[:] += lax.dot_general(
+            h_prev, d_pre, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return 0
+
+    lax.fori_loop(0, n_t, body, 0)
+    dw_out[0] = dw_scr[:].astype(dw_out.dtype)
+
+
+def _bwd_pallas(interpret, residuals, dhs):
+    x_padded, hs, cs, w_hh_t, b = residuals
+    n_t, b_pad, four_h = x_padded.shape
+    hidden = four_h // 4
+    dhs = _pad_rows(dhs, b_pad)
+    tile = _row_tile(b)
+    grid = (b_pad // tile,)
+
+    row_block = lambda width: pl.BlockSpec(  # noqa: E731
+        (n_t, tile, width), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+    )
+    dx, dw_partial = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            row_block(hidden),   # dhs
+            row_block(four_h),   # x_proj (aliased to dx when grid == 1)
+            row_block(hidden),   # hs
+            row_block(hidden),   # cs
+            pl.BlockSpec(
+                (hidden, four_h), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            row_block(four_h),
+            pl.BlockSpec(
+                (1, hidden, four_h), lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_t, b_pad, four_h), x_padded.dtype),
+            jax.ShapeDtypeStruct((grid[0], hidden, four_h), w_hh_t.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile, hidden), jnp.float32),
+            pltpu.VMEM((tile, hidden), jnp.float32),
+            pltpu.VMEM((hidden, four_h), jnp.float32),
+        ],
+        input_output_aliases={1: 0} if grid[0] == 1 else {},
+        interpret=interpret,
+    )(dhs, x_padded, hs, cs, w_hh_t)
+    return dx[:, :b], jnp.sum(dw_partial, axis=0)
+
+
+# -------------------------------------------------------------- public API
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _lstm_recurrence_pallas(x_proj, w_hh_t, interpret=False):
+    hs, _ = _fwd_pallas(x_proj, w_hh_t, interpret=interpret)
+    return hs
+
+
+def _vjp_fwd(x_proj, w_hh_t, interpret):
+    return _fwd_pallas(x_proj, w_hh_t, interpret=interpret)
+
+
+_lstm_recurrence_pallas.defvjp(_vjp_fwd, _bwd_pallas)
+
+
+def lstm_recurrence_xla(x_proj: jax.Array, w_hh_t: jax.Array) -> jax.Array:
+    """Reference formulation: ``lax.scan`` over time (XLA-fused fallback)."""
+    b = x_proj.shape[1]
+    hidden = w_hh_t.shape[0]
+    carry0 = (
+        jnp.zeros((b, hidden), x_proj.dtype),
+        jnp.zeros((b, hidden), x_proj.dtype),
+    )
+
+    def step(carry, xt):
+        h, c = carry
+        i, f, g, o = _gate_math(xt + h @ w_hh_t)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    _, hs = lax.scan(step, carry0, x_proj)
+    return hs
+
+
+def lstm_recurrence(
+    x_proj: jax.Array, w_hh_t: jax.Array, impl: str = "auto"
+) -> jax.Array:
+    """Run the LSTM time recurrence over pre-projected inputs.
+
+    Args:
+        x_proj: ``(T, B, 4H)`` time-major input projections (``x @ w_ihᵀ``
+            plus both biases), gate order i, f, g, o as in ``torch.nn.LSTM``.
+        w_hh_t: ``(H, 4H)`` transposed recurrent weight.
+        impl: ``"pallas"`` | ``"xla"`` | ``"interpret"`` | ``"auto"``
+            (pallas on TPU, xla elsewhere).
+
+    Returns:
+        ``(T, B, H)`` hidden states for every timestep.
+    """
+    if impl == "auto":
+        impl = (
+            "xla"
+            if os.environ.get("MT_TPU_DISABLE_PALLAS")
+            else ("pallas" if jax.default_backend() == "tpu" else "xla")
+        )
+    if impl == "pallas":
+        return _lstm_recurrence_pallas(x_proj, w_hh_t, False)
+    if impl == "interpret":
+        return _lstm_recurrence_pallas(x_proj, w_hh_t, True)
+    if impl == "xla":
+        return lstm_recurrence_xla(x_proj, w_hh_t)
+    raise ValueError(f"unknown lstm impl: {impl!r}")

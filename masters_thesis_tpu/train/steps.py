@@ -26,7 +26,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from masters_thesis_tpu.data.pipeline import Batch
 from masters_thesis_tpu.models.objectives import (
@@ -143,7 +143,19 @@ def make_train_epoch(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    # Explicit shardings keep the jit signature identical across epochs.
+    # Without them, epoch 0 (unspecified shardings) and epoch 1 (donated
+    # outputs carrying concrete shardings) trigger TWO multi-second XLA
+    # compiles of the same program.
+    repl = NamedSharding(mesh, P())
+    batch_sh = Batch(*(NamedSharding(mesh, s) for s in data_spec))
+    idx_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    return jax.jit(
+        sharded,
+        donate_argnums=(0, 1),
+        in_shardings=(repl, repl, repl, repl, batch_sh, idx_sh),
+        out_shardings=(repl, repl, repl),
+    )
 
 
 def make_train_step(
@@ -159,8 +171,6 @@ def make_train_step(
     replicated, and XLA's sharding propagation inserts the gradient
     all-reduce — no explicit collectives in user code.
     """
-    from jax.sharding import NamedSharding
-
     loss_fn = _make_loss_fn(module, window_objective)
 
     def step_fn(params, opt_state, lr, rng, batch: Batch):
@@ -267,4 +277,11 @@ def make_eval_fn(
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    repl = NamedSharding(mesh, P())
+    batch_sh = Batch(*(NamedSharding(mesh, s) for s in data_spec))
+    mask_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    return jax.jit(
+        sharded,
+        in_shardings=(repl, batch_sh, mask_sh),
+        out_shardings=repl,
+    )

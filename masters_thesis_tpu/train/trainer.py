@@ -196,20 +196,23 @@ class Trainer:
             self._print(f"model: {spec.objective} | params: {n_params:,} "
                         f"| mesh: {self.n_dev}x{DATA_AXIS} | {self.strategy}")
 
+        from masters_thesis_tpu.parallel import replicated_sharding
+
         tx = make_optimizer(self.gradient_clip_val, spec.weight_decay)
         opt_state = tx.init(params)
+        repl = replicated_sharding(self.mesh)
         if init_state is not None:
-            from masters_thesis_tpu.parallel import replicated_sharding
             from masters_thesis_tpu.train.checkpoint import restore_opt_state
 
-            repl = replicated_sharding(self.mesh)
-            params = jax.device_put(
-                jax.tree_util.tree_map(jnp.asarray, init_state[0]), repl
+            params = jax.tree_util.tree_map(jnp.asarray, init_state[0])
+            opt_state = restore_opt_state(
+                jax.device_get(opt_state), init_state[1]
             )
-            opt_state = jax.device_put(
-                restore_opt_state(jax.device_get(opt_state), init_state[1]),
-                repl,
-            )
+        # Commit to the mesh BEFORE the first epoch: epoch outputs carry
+        # mesh-tagged avals, and untagged first-call inputs would otherwise
+        # trace+compile the epoch program a second time at epoch 1.
+        params = jax.device_put(params, repl)
+        opt_state = jax.device_put(opt_state, repl)
         scheduler = PlateauScheduler(spec.learning_rate)
         objective = spec.window_objective()
 
