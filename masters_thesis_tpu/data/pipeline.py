@@ -104,7 +104,10 @@ class FinancialWindowDataModule:
         prediction_task: bool = True,
         interaction_only: bool = True,
         batch_size: int = 1,
+        engine: str = "auto",
     ):
+        if engine not in ("auto", "native", "python"):
+            raise ValueError(f"unknown engine: {engine!r}")
         self.data_dir = Path(data_dir)
         self.lookback_window = lookback_window
         self.target_window = target_window
@@ -112,6 +115,7 @@ class FinancialWindowDataModule:
         self.prediction_task = prediction_task
         self.interaction_only = interaction_only
         self.batch_size = batch_size
+        self.engine = engine
 
         self.train_range: range | None = None
         self.val_range: range | None = None
@@ -168,16 +172,9 @@ class FinancialWindowDataModule:
         alphas = self._load_if_exists("alphas.npy")
         betas = self._load_if_exists("betas.npy")
 
-        x, y = lookback_target_split(
-            r_stocks,
-            r_market,
-            lookback_window=self.lookback_window,
-            target_window=self.target_window,
-            stride=self.stride,
-            prediction=self.prediction_task,
+        x, y, t_alphas, t_betas, t_factor, t_inv_psi = self._build_windows(
+            r_stocks, r_market, verbose=verbose
         )
-        x = add_quadratic_features(x, interaction_only=self.interaction_only)
-        t_alphas, t_betas, t_factor, t_inv_psi = ols_features(y)
 
         # Real data has no ground-truth coefficients; supervise with the
         # target-window OLS fit instead (reference: src/data.py:209-211).
@@ -208,6 +205,50 @@ class FinancialWindowDataModule:
             inv_psi=np.asarray(t_inv_psi),
         )
         hash_file.write_text(hparams_hash)
+
+    def _build_windows(self, r_stocks, r_market, verbose: bool):
+        """Window + feature-expand + OLS-label pass, native engine preferred.
+
+        ``engine='auto'`` uses the C++ builder when a compiler/cached build is
+        available and falls back to the jnp pipeline otherwise; both paths are
+        parity-tested (tests/test_native_engine.py).
+        """
+        if self.engine in ("auto", "native"):
+            from masters_thesis_tpu import native
+
+            try:
+                if self.engine == "native" or native.available():
+                    out = native.build_dataset(
+                        np.asarray(r_stocks),
+                        np.asarray(r_market),
+                        lookback_window=self.lookback_window,
+                        target_window=self.target_window,
+                        stride=self.stride,
+                        prediction=self.prediction_task,
+                        interaction_only=self.interaction_only,
+                    )
+                    return (
+                        out["x"], out["y"], out["alphas"], out["betas"],
+                        out["factor"], out["inv_psi"],
+                    )
+            except (native.NativeBuildError, OSError) as exc:
+                # OSError covers an unloadable cached .so (wrong arch/corrupt).
+                if self.engine == "native":
+                    raise
+                if verbose:
+                    print(f"native engine unavailable ({exc}); using jnp path")
+
+        x, y = lookback_target_split(
+            r_stocks,
+            r_market,
+            lookback_window=self.lookback_window,
+            target_window=self.target_window,
+            stride=self.stride,
+            prediction=self.prediction_task,
+        )
+        x = add_quadratic_features(x, interaction_only=self.interaction_only)
+        t_alphas, t_betas, t_factor, t_inv_psi = ols_features(y)
+        return x, y, t_alphas, t_betas, t_factor, t_inv_psi
 
     # ----------------------------------------------------------------- setup
 

@@ -1,0 +1,19 @@
+"""Native (C++) runtime components of the framework.
+
+Currently: the multithreaded window/feature/OLS dataset builder — the
+framework's native host-side data path (the reference delegates this role to
+torch's strided-view kernels and DataLoader worker processes,
+src/data.py:236-244). Loaded lazily; everything degrades to the pure-JAX
+pipeline when no C++ compiler is available.
+"""
+
+from masters_thesis_tpu.native.build import NativeBuildError, ensure_built
+from masters_thesis_tpu.native.engine import available, build_dataset, num_windows
+
+__all__ = [
+    "NativeBuildError",
+    "available",
+    "build_dataset",
+    "ensure_built",
+    "num_windows",
+]

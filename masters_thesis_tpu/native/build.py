@@ -1,0 +1,72 @@
+"""Lazy, cached build of the native window engine shared library.
+
+The library is compiled on first use with the system ``g++`` into
+``<package>/native/_build/window_engine_<srchash>.so`` — hashing the source
+into the filename makes rebuilds automatic when the C++ changes and makes the
+cache safe to keep across versions. No pybind11/setuptools machinery: the
+engine exposes a plain C ABI consumed via ctypes (see engine.py), so the only
+build dependency is a C++ compiler; when none is present the framework
+transparently falls back to the pure-JAX pipeline path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+_NATIVE_DIR = Path(__file__).resolve().parent
+_SOURCE = _NATIVE_DIR / "window_engine.cpp"
+_BUILD_DIR = _NATIVE_DIR / "_build"
+
+_CXX_FLAGS = [
+    "-O3",
+    "-std=c++17",
+    "-shared",
+    "-fPIC",
+    "-pthread",
+    "-fvisibility=hidden",
+]
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _source_hash() -> str:
+    return hashlib.sha256(_SOURCE.read_bytes()).hexdigest()[:16]
+
+
+def library_path() -> Path:
+    return _BUILD_DIR / f"window_engine_{_source_hash()}.so"
+
+
+def compiler() -> str | None:
+    return shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+
+
+def ensure_built(verbose: bool = False) -> Path:
+    """Compile the engine if its cached build is missing; returns the .so path."""
+    lib = library_path()
+    if lib.exists():
+        return lib
+    cxx = compiler()
+    if cxx is None:
+        raise NativeBuildError("no C++ compiler found (g++/c++/clang++)")
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # Per-process tmp name: concurrent first-use builders (pytest-xdist,
+    # multi-host on shared FS) each write their own file; the final rename is
+    # atomic, so whoever publishes last wins with an intact library.
+    tmp = lib.with_suffix(f".so.tmp{os.getpid()}")
+    cmd = [cxx, *_CXX_FLAGS, str(_SOURCE), "-o", str(tmp)]
+    if verbose:
+        print("building native window engine:", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native engine build failed (exit {proc.returncode}):\n{proc.stderr}"
+        )
+    tmp.replace(lib)  # atomic: concurrent builders race benignly
+    return lib

@@ -1,0 +1,103 @@
+"""ctypes bindings for the native C++ window engine.
+
+``build_dataset`` is the one-call native equivalent of the Python pipeline's
+window construction (reference: src/common.py:81-148 composed by
+src/data.py:196-214): it returns the feature-expanded lookback windows, raw
+target channels, and per-window OLS supervision labels as freshly-allocated
+numpy arrays, computed by the multithreaded C++ engine. ``available()``
+reports whether the engine can be (or already is) built on this machine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+
+import numpy as np
+
+from masters_thesis_tpu.native.build import (
+    NativeBuildError,
+    compiler,
+    ensure_built,
+    library_path,
+)
+
+_i64 = ctypes.c_longlong
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+@functools.lru_cache(maxsize=1)
+def _load() -> ctypes.CDLL:
+    lib = ctypes.CDLL(str(ensure_built()))
+    lib.mt_num_windows.restype = _i64
+    lib.mt_num_windows.argtypes = [_i64, _i64, _i64]
+    lib.mt_build_dataset.restype = ctypes.c_int
+    lib.mt_build_dataset.argtypes = [
+        _f32p, _f32p,  # stocks, market
+        _i64, _i64, _i64, _i64, _i64,  # K, T, L, Tt, stride
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # prediction, interaction_only, n_threads
+        _f32p, _f32p, _f32p, _f32p, _f32p, _f32p,  # x, y, alphas, betas, factor, inv_psi
+    ]
+    return lib
+
+
+def available() -> bool:
+    """True iff the engine is already built or a compiler is on PATH."""
+    return library_path().exists() or compiler() is not None
+
+
+def num_windows(n_samples: int, total_window: int, stride: int) -> int:
+    return int(_load().mt_num_windows(n_samples, total_window, stride))
+
+
+def build_dataset(
+    stocks: np.ndarray,
+    market: np.ndarray,
+    lookback_window: int,
+    target_window: int,
+    stride: int,
+    prediction: bool = True,
+    interaction_only: bool = True,
+    n_threads: int = 0,
+) -> dict[str, np.ndarray]:
+    """Run the fused native window/feature/OLS pass.
+
+    Args mirror the Python pipeline (see ops/windows.py). Returns a dict with
+    ``x (n_win, K, L, F)``, ``y (n_win, K, Tt, 2)``, ``alphas``/``betas``/
+    ``inv_psi (n_win, K)``, and ``factor (n_win, 2)``, all float32.
+    """
+    stocks = np.ascontiguousarray(stocks, np.float32)
+    market = np.ascontiguousarray(market, np.float32)
+    if stocks.ndim != 2 or market.ndim != 1 or stocks.shape[1] != market.shape[0]:
+        raise ValueError(
+            f"expected stocks (K, T) and market (T,); got {stocks.shape} "
+            f"and {market.shape}"
+        )
+    k, t = stocks.shape
+    total = lookback_window + target_window if prediction else lookback_window
+    lib = _load()
+    n_win = int(lib.mt_num_windows(t, total, stride))
+    if n_win < 1:
+        raise ValueError(
+            f"series of length {t} is shorter than one window ({total} steps)"
+        )
+    n_features = 3 if interaction_only else 5
+
+    x = np.empty((n_win, k, lookback_window, n_features), np.float32)
+    y = np.empty((n_win, k, target_window, 2), np.float32)
+    alphas = np.empty((n_win, k), np.float32)
+    betas = np.empty((n_win, k), np.float32)
+    factor = np.empty((n_win, 2), np.float32)
+    inv_psi = np.empty((n_win, k), np.float32)
+
+    rc = lib.mt_build_dataset(
+        stocks, market, k, t, lookback_window, target_window, stride,
+        int(prediction), int(interaction_only), int(n_threads),
+        x, y, alphas, betas, factor, inv_psi,
+    )
+    if rc != 0:
+        raise NativeBuildError(f"mt_build_dataset failed with code {rc}")
+    return {
+        "x": x, "y": y, "alphas": alphas, "betas": betas,
+        "factor": factor, "inv_psi": inv_psi,
+    }

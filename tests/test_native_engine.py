@@ -1,0 +1,109 @@
+"""Parity tests: native C++ window engine vs the pure-JAX pipeline path.
+
+The native engine must produce the same dataset the jnp pipeline does
+(reference semantics: src/common.py:81-148 composed by src/data.py:196-214),
+within float32 rounding — both paths feed the same training stack.
+"""
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu import native
+from masters_thesis_tpu.ops import (
+    add_quadratic_features,
+    lookback_target_split,
+    ols_features,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ compiler / cached native build"
+)
+
+
+def _series(rng, k=7, t=500):
+    stocks = rng.normal(0.01, 0.5, size=(k, t)).astype(np.float32)
+    market = rng.normal(0.02, 0.4, size=(t,)).astype(np.float32)
+    return stocks, market
+
+
+@pytest.mark.parametrize("interaction_only", [True, False])
+@pytest.mark.parametrize("prediction", [True, False])
+def test_matches_jnp_pipeline(rng, interaction_only, prediction):
+    stocks, market = _series(rng)
+    kw = dict(lookback_window=24, target_window=12, stride=20)
+
+    out = native.build_dataset(
+        stocks, market, prediction=prediction,
+        interaction_only=interaction_only, **kw,
+    )
+    x_ref, y_ref = lookback_target_split(
+        stocks, market, prediction=prediction,
+        lookback_window=kw["lookback_window"],
+        target_window=kw["target_window"], stride=kw["stride"],
+    )
+    x_ref = add_quadratic_features(x_ref, interaction_only=interaction_only)
+    a_ref, b_ref, f_ref, ip_ref = ols_features(y_ref)
+
+    np.testing.assert_array_equal(out["x"], np.asarray(x_ref))
+    np.testing.assert_array_equal(out["y"], np.asarray(y_ref))
+    np.testing.assert_allclose(out["alphas"], np.asarray(a_ref), atol=2e-5)
+    np.testing.assert_allclose(out["betas"], np.asarray(b_ref), atol=2e-4)
+    np.testing.assert_allclose(out["factor"], np.asarray(f_ref), rtol=2e-5)
+    np.testing.assert_allclose(out["inv_psi"], np.asarray(ip_ref), rtol=2e-3)
+
+
+def test_degenerate_constant_market_matches_pinv(rng):
+    """Constant market regressor: native must match pinv's min-norm solution."""
+    k, t = 3, 64
+    stocks = rng.normal(0.01, 0.5, size=(k, t)).astype(np.float32)
+    market = np.full((t,), 0.25, np.float32)
+    kw = dict(lookback_window=16, target_window=16, stride=32)
+
+    out = native.build_dataset(stocks, market, **kw)
+    _, y_ref = lookback_target_split(
+        stocks, market, prediction=True,
+        lookback_window=16, target_window=16, stride=32,
+    )
+    a_ref, b_ref, _, _ = ols_features(y_ref)
+    np.testing.assert_allclose(out["alphas"], np.asarray(a_ref), atol=1e-5)
+    np.testing.assert_allclose(out["betas"], np.asarray(b_ref), atol=1e-5)
+
+
+def test_num_windows_edges():
+    assert native.num_windows(100, 90, 90) == 1
+    assert native.num_windows(180, 90, 90) == 2
+    assert native.num_windows(89, 90, 90) == -1
+    assert native.num_windows(100, 90, 5) == 3
+
+
+def test_single_thread_matches_parallel(rng):
+    stocks, market = _series(rng, k=3, t=400)
+    kw = dict(lookback_window=16, target_window=8, stride=10)
+    a = native.build_dataset(stocks, market, n_threads=1, **kw)
+    b = native.build_dataset(stocks, market, n_threads=8, **kw)
+    for key in a:
+        np.testing.assert_array_equal(a[key], b[key])
+
+
+def test_datamodule_native_equals_python(rng, tmp_path):
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+
+    stocks, market = _series(rng, k=4, t=600)
+    for sub in ("nat", "py"):
+        d = tmp_path / sub
+        d.mkdir()
+        np.save(d / "stocks.npy", stocks)
+        np.save(d / "market.npy", market)
+
+    kw = dict(lookback_window=20, target_window=10, stride=30)
+    dm_nat = FinancialWindowDataModule(tmp_path / "nat", engine="native", **kw)
+    dm_py = FinancialWindowDataModule(tmp_path / "py", engine="python", **kw)
+    for dm in (dm_nat, dm_py):
+        dm.prepare_data(verbose=False)
+        dm.setup()
+
+    nat, py = dm_nat.train_arrays(), dm_py.train_arrays()
+    np.testing.assert_array_equal(nat.x, py.x)
+    np.testing.assert_allclose(nat.y, py.y, atol=2e-5)
+    np.testing.assert_allclose(nat.factor, py.factor, rtol=2e-5)
+    np.testing.assert_allclose(nat.inv_psi, py.inv_psi, rtol=2e-3)

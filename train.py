@@ -79,6 +79,7 @@ def build_datamodule(cfg: Config):
         prediction_task=d.prediction_task,
         interaction_only=d.interaction_only,
         batch_size=d.batch_size,
+        engine=d.get("engine", "auto"),
     )
 
 
