@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import platform
 import shutil
 import subprocess
 from pathlib import Path
@@ -40,7 +41,11 @@ def _source_hash() -> str:
 
 
 def library_path() -> Path:
-    return _BUILD_DIR / f"window_engine_{_source_hash()}.so"
+    # Arch in the cache key: on a shared filesystem, hosts of different
+    # architectures each build and load their own binary.
+    return _BUILD_DIR / (
+        f"window_engine_{platform.machine()}_{_source_hash()}.so"
+    )
 
 
 def compiler() -> str | None:

@@ -28,7 +28,12 @@ _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 @functools.lru_cache(maxsize=1)
 def _load() -> ctypes.CDLL:
-    lib = ctypes.CDLL(str(ensure_built()))
+    try:
+        lib = ctypes.CDLL(str(ensure_built()))
+    except OSError:
+        # Self-heal a corrupt/incompatible cached build: rebuild once.
+        library_path().unlink(missing_ok=True)
+        lib = ctypes.CDLL(str(ensure_built()))
     lib.mt_num_windows.restype = _i64
     lib.mt_num_windows.argtypes = [_i64, _i64, _i64]
     lib.mt_build_dataset.restype = ctypes.c_int

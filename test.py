@@ -50,7 +50,7 @@ def main(argv: list[str] | None = None) -> None:
         print("No model checkpoint found, exiting...", file=sys.stderr)
         return
 
-    from masters_thesis_tpu.evaluation import collect_test_results
+    from masters_thesis_tpu.evaluation import collect_test_results, delta_losses
     from masters_thesis_tpu.train.checkpoint import restore_checkpoint
     from masters_thesis_tpu.train.logging import TensorBoardLogger
     from masters_thesis_tpu.viz import (
@@ -140,6 +140,26 @@ def main(argv: list[str] | None = None) -> None:
                 est_kind=kind,
             ),
         )
+    # Thesis results-table metrics: losses above the OLS-on-target baseline
+    # (reference: tex/diplomski_rad.tex:1155-1176 reports ΔL_MSE ×1e-5,
+    # ΔL_NLL, and ΔL_MIX(ζ=1e5) for the model and the lookback-OLS row).
+    deltas = delta_losses(spec, params, dm, estimates=results)
+    scalars = {}
+    for key in ("model", "ols"):
+        d = deltas[key]
+        scalars.update(
+            {
+                f"delta/{key}/mse": d["delta_mse"],
+                f"delta/{key}/nll": d["delta_nll"],
+                f"delta/{key}/mix": d["delta_mix"],
+            }
+        )
+        print(
+            f"{key:>6}: dL_MSE(x1e-5)={d['delta_mse'] * 1e5:7.3f}  "
+            f"dL_NLL={d['delta_nll']:7.3f}  "
+            f"dL_MIX(zeta=1e5)={d['delta_mix']:7.3f}"
+        )
+    tb.log_scalars(scalars, 0)
     tb.close()
     print(f"figures written to {tb.log_dir}")
 

@@ -1,0 +1,81 @@
+"""Evaluation tests: result collection schema and the thesis ΔL metrics.
+
+ΔL semantics (reference: tex/diplomski_rad.tex:1077-1084): loss above the
+OLS-fit-on-the-TARGET-window baseline. Because target-window OLS minimizes
+the squared error on exactly the window the losses are evaluated on, every
+other estimator's ΔL_MSE is non-negative by construction — the tests lean on
+that invariant.
+"""
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.evaluation import collect_test_results, delta_losses
+from masters_thesis_tpu.models.objectives import ModelSpec
+
+
+@pytest.fixture(scope="module")
+def eval_setup(tmp_path_factory):
+    data_dir = tmp_path_factory.mktemp("eval_data")
+    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+        n_stocks=6, n_samples=3000, seed=3
+    )
+    np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+    np.save(data_dir / "market.npy", np.asarray(r_market))
+    np.save(data_dir / "alphas.npy", np.asarray(alphas))
+    np.save(data_dir / "betas.npy", np.asarray(betas))
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+
+    spec = ModelSpec(objective="mse", hidden_size=8, num_layers=1, dropout=0.0)
+    import jax
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    params = module.init(
+        jax.random.key(0), jnp.zeros((1, dm.lookback_window, dm.n_features))
+    )["params"]
+    return spec, params, dm
+
+
+def test_collect_results_schema(eval_setup):
+    spec, params, dm = eval_setup
+    results = collect_test_results(spec, params, dm)
+    n = len(dm.test_range)
+    assert results["alpha"]["model"].shape == (n, 6)
+    assert results["beta"]["true"].shape == (n, 6)
+    assert np.isfinite(results["recon_residuals"]["ols"]).all()
+
+
+def test_delta_losses_invariants(eval_setup):
+    spec, params, dm = eval_setup
+    deltas = delta_losses(spec, params, dm)
+
+    for key in ("model", "ols"):
+        d = deltas[key]
+        assert np.isfinite([d["delta_mse"], d["delta_nll"], d["delta_mix"]]).all()
+        # Target-window OLS is the per-window MSE minimizer.
+        assert d["delta_mse"] >= -1e-9
+        assert d["delta_mix"] == pytest.approx(
+            d["delta_nll"] + deltas["zeta"] * d["delta_mse"], rel=1e-6
+        )
+    assert np.isfinite(deltas["baseline"]["nll"])
+    # An untrained encoder should sit above the analytical OLS estimator.
+    assert deltas["model"]["delta_mse"] > deltas["ols"]["delta_mse"]
+
+
+def test_delta_losses_reuses_collected_estimates(eval_setup):
+    spec, params, dm = eval_setup
+    results = collect_test_results(spec, params, dm)
+    direct = delta_losses(spec, params, dm)
+    reused = delta_losses(spec, params, dm, estimates=results)
+    for key in ("model", "ols"):
+        for metric in ("delta_mse", "delta_nll", "delta_mix"):
+            assert reused[key][metric] == pytest.approx(
+                direct[key][metric], rel=1e-5
+            )
