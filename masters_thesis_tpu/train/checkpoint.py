@@ -59,7 +59,11 @@ def save_checkpoint(
         ckptr.wait_until_finished()
     sidecar = {"spec": dataclasses.asdict(spec), "meta": meta}
     if jax.process_index() == 0:
-        (ckpt_dir / f"{tag}.json").write_text(json.dumps(sidecar, indent=2))
+        # Atomic publish: a crash mid-write must not leave a torn sidecar
+        # (the auto-resume path reads it on restart).
+        tmp = ckpt_dir / f"{tag}.json.tmp"
+        tmp.write_text(json.dumps(sidecar, indent=2))
+        tmp.replace(ckpt_dir / f"{tag}.json")
 
 
 def restore_checkpoint(

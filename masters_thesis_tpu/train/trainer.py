@@ -86,6 +86,7 @@ class Trainer:
         ckpt_dir: str | Path | None = None,
         seed: int = 0,
         name: str = "fast",
+        resume: bool = False,
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
@@ -106,6 +107,7 @@ class Trainer:
         self.ckpt_dir = Path(ckpt_dir) if ckpt_dir else None
         self.seed = seed
         self.name = name
+        self.resume = resume
 
     # ----------------------------------------------------------- data prep
 
@@ -179,7 +181,9 @@ class Trainer:
         init_state: tuple[Any, Any] | None = None,
     ) -> TrainResult:
         """Train; ``init_state=(params, opt_state)`` resumes from a
-        checkpoint (reference: train.py:187 passes ckpt_path to fit)."""
+        checkpoint (reference: train.py:187 passes ckpt_path to fit);
+        ``init_state=(params, None)`` warm-starts the weights with a fresh
+        optimizer (the thesis' synthetic->real warmup protocol)."""
         dm.prepare_data(verbose=self.enable_progress_bar)
         dm.setup("fit")
 
@@ -205,15 +209,50 @@ class Trainer:
             from masters_thesis_tpu.train.checkpoint import restore_opt_state
 
             params = jax.tree_util.tree_map(jnp.asarray, init_state[0])
-            opt_state = restore_opt_state(
-                jax.device_get(opt_state), init_state[1]
+            if init_state[1] is not None:  # None = warm start, fresh optimizer
+                opt_state = restore_opt_state(
+                    jax.device_get(opt_state), init_state[1]
+                )
+        scheduler = PlateauScheduler(spec.learning_rate)
+        start_epoch = 0
+        best_val = float("inf")
+        # Failure recovery: pick up where the 'last' checkpoint left off —
+        # params, optimizer moments, LR-scheduler state, best-val watermark,
+        # and epoch counter (the reference's only resume affordance is
+        # Lightning's save_last=True, train.py:159; restart semantics there
+        # require manually passing ckpt_path).
+        # Both the orbax tree AND the sidecar must exist: a crash mid-save
+        # can leave one without the other (the sidecar is written after the
+        # orbax commit); in that case train from scratch rather than die.
+        if (
+            self.resume
+            and self.ckpt_dir
+            and (self.ckpt_dir / "last").exists()
+            and (self.ckpt_dir / "last.json").exists()
+        ):
+            from masters_thesis_tpu.train.checkpoint import (
+                restore_checkpoint,
+                restore_opt_state,
+            )
+
+            r_params, r_opt, _, r_meta = restore_checkpoint(
+                self.ckpt_dir, "last"
+            )
+            params = jax.tree_util.tree_map(jnp.asarray, r_params)
+            opt_state = restore_opt_state(jax.device_get(opt_state), r_opt)
+            start_epoch = int(r_meta.get("epoch", -1)) + 1
+            if r_meta.get("best_val") is not None:
+                best_val = float(r_meta["best_val"])
+            if r_meta.get("scheduler"):
+                scheduler.load_state_dict(r_meta["scheduler"])
+            self._print(
+                f"resuming from {self.ckpt_dir / 'last'} at epoch {start_epoch}"
             )
         # Commit to the mesh BEFORE the first epoch: epoch outputs carry
         # mesh-tagged avals, and untagged first-call inputs would otherwise
         # trace+compile the epoch program a second time at epoch 1.
         params = jax.device_put(params, repl)
         opt_state = jax.device_put(opt_state, repl)
-        scheduler = PlateauScheduler(spec.learning_rate)
         objective = spec.window_objective()
 
         val_prepared = self._eval_split(dm.val_arrays())
@@ -267,12 +306,11 @@ class Trainer:
             raise ValueError(f"unknown epoch_mode: {self.epoch_mode!r}")
 
         history: list[dict] = []
-        best_val = float("inf")
         total_steps = 0
         t_start = None  # set after first epoch (excludes compile)
 
-        for epoch in range(self.max_epochs):
-            if self.profile and epoch == 1:
+        for epoch in range(start_epoch, self.max_epochs):
+            if self.profile and epoch == start_epoch + 1:
                 jax.profiler.start_trace(
                     str((self.logger.log_dir if self.logger else Path("logs"))
                         / "profile")
@@ -284,7 +322,7 @@ class Trainer:
             )
             train_metrics = metric_means(jax.device_get(sums))
             total_steps += steps_per_epoch
-            if epoch == 0:
+            if t_start is None:
                 jax.block_until_ready(params)
                 t_start = time.perf_counter()
 
@@ -300,15 +338,17 @@ class Trainer:
                 row["lr"] = new_lr
                 if val_loss < best_val:
                     best_val = val_loss
-                    self._save("best", params, opt_state, spec, epoch, val_loss, dm)
-                self._save("last", params, opt_state, spec, epoch, val_loss, dm)
+                    self._save("best", params, opt_state, spec, epoch,
+                               val_loss, dm, scheduler, best_val)
+                self._save("last", params, opt_state, spec, epoch, val_loss,
+                           dm, scheduler, best_val)
 
             if self.logger:
                 self.logger.log_scalars(
                     {k: v for k, v in row.items() if k != "epoch"}, epoch
                 )
             history.append(row)
-            if self.profile and epoch == 1:
+            if self.profile and epoch == start_epoch + 1:
                 jax.block_until_ready(params)
                 jax.profiler.stop_trace()
             self._print(
@@ -324,14 +364,16 @@ class Trainer:
         elapsed = time.perf_counter() - (t_start or time.perf_counter())
         post_compile_steps = total_steps - steps_per_epoch
         steps_per_sec = (
-            post_compile_steps / elapsed if elapsed > 0 and post_compile_steps else 0.0
+            post_compile_steps / elapsed
+            if elapsed > 0 and post_compile_steps > 0
+            else 0.0
         )
 
         # 'last' must hold the FINAL params even when the last epoch wasn't a
         # val epoch (Lightning's save_last=True, train.py:159).
         if self.ckpt_dir:
             self._save("last", params, opt_state, spec, self.max_epochs - 1,
-                       best_val, dm)
+                       best_val, dm, scheduler, best_val)
 
         return TrainResult(
             params=params,
@@ -364,7 +406,8 @@ class Trainer:
 
     # ------------------------------------------------------------- helpers
 
-    def _save(self, tag, params, opt_state, spec, epoch, val_loss, dm):
+    def _save(self, tag, params, opt_state, spec, epoch, val_loss, dm,
+              scheduler=None, best_val=None):
         if not self.ckpt_dir:
             return
         ckpt_lib.save_checkpoint(
@@ -372,6 +415,9 @@ class Trainer:
             meta={
                 "epoch": epoch,
                 "val_loss": float(val_loss),
+                # Resume state: LR-scheduler + best-val watermark.
+                "scheduler": scheduler.state_dict() if scheduler else None,
+                "best_val": None if best_val is None else float(best_val),
                 "trainer": self.name,
                 "datamodule": {
                     "lookback_window": dm.lookback_window,

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Warmup protocol: pretrain on the synthetic DGP with the combined (L_MIX)
+# objective — the best synthetic-trained configuration in the thesis — then
+# fine-tune on the real Fama-French data from those weights with a fresh
+# optimizer (reference: tex/diplomski_rad.tex:1134-1147; the reference has
+# no code for this and does it "by hand via checkpoints", SURVEY.md §2.3).
+set -e
+
+# Stage 1: synthetic pretraining (L_MIX objective).
+python train.py datamodule=synthetic model=large loss=combined trainer=slow
+
+PRETRAINED="logs/FinancialLstm/synthetic/combined_large_lr0.0001_slow/checkpoints/best"
+
+# Stage 2: real-data fine-tune sweep from the pretrained weights
+# (fresh optimizer: checkpoint_mode=params).
+python train.py -m datamodule=real model=large \
+    loss=mse,nll,combined \
+    model.learning_rate=1e-4,1e-5 \
+    trainer=slow \
+    checkpoint="$PRETRAINED" \
+    checkpoint_mode=params \
+    logger.name=FinancialLstm/warmup

@@ -110,3 +110,16 @@ def test_flat_dict():
     flat = to_flat_dict(cfg)
     assert flat["model.hidden_size"] == 64
     assert flat["datamodule.lookback_window"] == 60
+
+
+def test_partition_jobs_round_robin():
+    """Multi-host sweep dispatch: hosts cover all jobs exactly once."""
+    import train as train_mod
+
+    jobs = [[f"j={i}"] for i in range(7)]
+    shards = [train_mod.partition_jobs(jobs, h, 3) for h in range(3)]
+    assert [len(s) for s in shards] == [3, 2, 2]
+    flat = [j for s in shards for j in s]
+    assert sorted(flat) == sorted(jobs)
+    with pytest.raises(ValueError):
+        train_mod.partition_jobs(jobs, 3, 3)

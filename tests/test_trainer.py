@@ -135,6 +135,54 @@ class TestCheckpoint:
         ):
             np.testing.assert_allclose(a, b, rtol=1e-6)
 
+    def test_warmup_params_only_fine_tune(self, tiny_dm, tmp_path):
+        """Warmup protocol: pretrained weights + fresh optimizer continue to
+        train (reference: tex/diplomski_rad.tex:1134-1147 — synthetic->real
+        fine-tune; the fine-tune's first epoch should start from the
+        pretrained loss level, not from random init)."""
+        ckpt_dir = tmp_path / "ckpts"
+        pre = make_trainer(ckpt_dir=ckpt_dir, max_epochs=3).fit(
+            small_spec(), tiny_dm
+        )
+        params, _, spec, _ = restore_checkpoint(ckpt_dir, "last")
+
+        fresh = make_trainer(max_epochs=1).fit(small_spec(), tiny_dm)
+        warm = make_trainer(max_epochs=1).fit(
+            small_spec(), tiny_dm, init_state=(params, None)
+        )
+        assert np.isfinite(warm.history[0]["loss/total/train"])
+        # Warm start must begin near the pretrained loss, below random init.
+        assert (
+            warm.history[0]["loss/total/train"]
+            < fresh.history[0]["loss/total/train"]
+        )
+        assert warm.history[0]["loss/total/train"] == pytest.approx(
+            pre.history[-1]["loss/total/train"], rel=0.5
+        )
+
+    def test_auto_resume_continues_from_last(self, tiny_dm, tmp_path):
+        """Elastic recovery: a killed run restarted with resume=True must
+        continue from the 'last' checkpoint (epoch counter, optimizer
+        moments, scheduler state) and end up matching an uninterrupted run's
+        epoch count."""
+        ckpt_dir = tmp_path / "ckpts"
+        # Simulate a crash after 2 of 4 epochs.
+        make_trainer(ckpt_dir=ckpt_dir, max_epochs=2).fit(
+            small_spec(), tiny_dm
+        )
+        resumed = make_trainer(
+            ckpt_dir=ckpt_dir, max_epochs=4, resume=True
+        ).fit(small_spec(), tiny_dm)
+        assert [row["epoch"] for row in resumed.history] == [2, 3]
+        _, _, _, meta = restore_checkpoint(ckpt_dir, "last")
+        assert meta["epoch"] == 3
+        assert meta["scheduler"]["lr"] > 0
+        # Resuming a finished run trains zero additional epochs.
+        noop = make_trainer(
+            ckpt_dir=ckpt_dir, max_epochs=4, resume=True
+        ).fit(small_spec(), tiny_dm)
+        assert noop.history == []
+
     def test_restored_params_reproduce_test_metrics(self, tiny_dm, tmp_path):
         ckpt_dir = tmp_path / "ckpts"
         trainer = make_trainer(ckpt_dir=ckpt_dir, max_epochs=2)

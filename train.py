@@ -20,6 +20,7 @@ process-per-job native launcher.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
@@ -106,6 +107,14 @@ def run(cfg: Config) -> float:
     from masters_thesis_tpu.train import Trainer
     from masters_thesis_tpu.train.logging import TensorBoardLogger
 
+    # Multi-host single-job training: initialize the JAX distributed runtime
+    # first so every host sees the global device mesh (replaces Lightning's
+    # NCCL process-group bring-up; SURVEY.md §2.2).
+    if cfg.trainer.get("distributed", False):
+        from masters_thesis_tpu.parallel import distributed_initialize
+
+        distributed_initialize()
+
     if not bootstrap(cfg):
         return float("inf")
     dm = build_datamodule(cfg)
@@ -131,6 +140,7 @@ def run(cfg: Config) -> float:
         ckpt_dir=ckpt_dir,
         seed=cfg.seed,
         name=t.name,
+        resume=t.get("resume", False),
     )
 
     init_state = None
@@ -138,7 +148,15 @@ def run(cfg: Config) -> float:
         from masters_thesis_tpu.train.checkpoint import restore_checkpoint
 
         params, opt_state, spec, _ = restore_checkpoint(Path(cfg.checkpoint))
-        init_state = (params, opt_state)
+        # 'params' = warmup protocol: reuse weights, fresh optimizer
+        # (reference: tex/diplomski_rad.tex:1134-1147 — synthetic-trained
+        # model continued on real data).
+        mode = cfg.get("checkpoint_mode", "full")
+        if mode not in ("full", "params"):
+            raise ValueError(
+                f"checkpoint_mode must be 'full' or 'params', got {mode!r}"
+            )
+        init_state = (params, opt_state if mode == "full" else None)
 
     result = trainer.fit(spec, dm, init_state=init_state)
     test_metrics = trainer.test(spec, result.params, dm)
@@ -168,6 +186,23 @@ def _run_job(config_dir: str, overrides: list[str]) -> float:
     return run(cfg)
 
 
+def partition_jobs(
+    jobs: list[list[str]], host_index: int, num_hosts: int
+) -> list[list[str]]:
+    """Round-robin shard of sweep points for multi-host dispatch.
+
+    Each host of a pod runs the same multirun command with its own
+    ``launcher.host_index`` and trains every ``num_hosts``-th sweep point —
+    the multi-host equivalent of the reference's joblib process-per-job
+    launcher (reference: configs/config.yaml:6,17-19).
+    """
+    if not (0 <= host_index < num_hosts):
+        raise ValueError(
+            f"host_index {host_index} out of range for {num_hosts} hosts"
+        )
+    return jobs[host_index::num_hosts]
+
+
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("overrides", nargs="*", help="key=value config overrides")
@@ -184,6 +219,19 @@ def main(argv: list[str] | None = None) -> None:
     jobs = expand_multirun(args.overrides)
     cfg0 = compose(str(CONFIG_DIR), overrides=jobs[0])
     n_jobs = int(cfg0.launcher.get("n_jobs", 1))
+    num_hosts = int(
+        os.environ.get("MT_NUM_HOSTS", cfg0.launcher.get("num_hosts", 1))
+    )
+    host_index = int(
+        os.environ.get("MT_HOST_INDEX", cfg0.launcher.get("host_index", 0))
+    )
+    total = len(jobs)
+    if num_hosts > 1:
+        jobs = partition_jobs(jobs, host_index, num_hosts)
+        print(
+            f"multirun: host {host_index}/{num_hosts} takes "
+            f"{len(jobs)}/{total} jobs"
+        )
     print(f"multirun: {len(jobs)} jobs, n_jobs={n_jobs}")
     if n_jobs == 1:
         # Sequential jobs share this process (and its one TPU client).
