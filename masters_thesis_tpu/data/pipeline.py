@@ -27,6 +27,7 @@ import numpy as np
 
 from masters_thesis_tpu.data.fama_french import FamaFrench25Portfolios
 from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.utils import atomic_publish, atomic_write_text
 from masters_thesis_tpu.ops import (
     add_quadratic_features,
     lookback_target_split,
@@ -154,18 +155,44 @@ class FinancialWindowDataModule:
         path = self.data_dir / filename
         return np.load(path) if path.exists() else None
 
-    def prepare_data(self, verbose: bool = True) -> None:
-        """Build the windowed dataset and cache it, keyed by the hparams hash."""
+    def prepare_data(
+        self, verbose: bool = True, cache_timeout_s: float = 600.0
+    ) -> None:
+        """Build the windowed dataset and cache it, keyed by the hparams hash.
+
+        Multi-host safe (SURVEY.md §7 hard parts: one writer or per-host
+        caches): on a shared ``data_dir`` only process 0 builds and the
+        others poll for the published cache; if nothing appears within
+        ``cache_timeout_s`` the directory is host-local, and the process
+        builds its own cache (atomic pid-suffixed publishing makes a
+        concurrent duplicate build harmless). The hash file is written AFTER
+        the dataset, so readers never observe a torn cache.
+        """
+        import jax
+
         hparams_hash = self._hparams_hash()
         self._datasets_dir.mkdir(parents=True, exist_ok=True)
         hash_file = self._datasets_dir / "hparams_hash.txt"
         dataset_file = self._datasets_dir / "dataset.npz"
 
-        if hash_file.exists() and dataset_file.exists():
-            if hash_file.read_text().strip() == hparams_hash:
-                if verbose:
-                    print("Dataset parameters unchanged, skipping data preparation")
+        def cache_ready() -> bool:
+            return (
+                hash_file.exists()
+                and dataset_file.exists()
+                and hash_file.read_text().strip() == hparams_hash
+            )
+
+        if cache_ready():
+            if verbose:
+                print("Dataset parameters unchanged, skipping data preparation")
+            return
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            if self._wait_for_cache(cache_ready, cache_timeout_s):
                 return
+            if verbose:
+                print(
+                    "no shared cache appeared; building a host-local one"
+                )
 
         r_stocks = np.load(self.data_dir / "stocks.npy")
         r_market = np.load(self.data_dir / "market.npy")
@@ -197,14 +224,35 @@ class FinancialWindowDataModule:
             axis=-1,
         )
 
-        np.savez(
-            dataset_file,
-            x=np.asarray(x),
-            y=y,
-            factor=np.asarray(t_factor),
-            inv_psi=np.asarray(t_inv_psi),
-        )
-        hash_file.write_text(hparams_hash)
+        # Atomic publish (dataset first, then hash): concurrent readers only
+        # accept the cache once both files are complete and consistent.
+        with atomic_publish(dataset_file) as tmp_dataset:
+            with open(tmp_dataset, "wb") as f:  # handle: savez keeps the name
+                np.savez(
+                    f,
+                    x=np.asarray(x),
+                    y=y,
+                    factor=np.asarray(t_factor),
+                    inv_psi=np.asarray(t_inv_psi),
+                )
+        atomic_write_text(hash_file, hparams_hash)
+
+    @staticmethod
+    def _wait_for_cache(cache_ready, timeout_s: float) -> bool:
+        """Non-writer processes poll for process 0's published cache.
+
+        Returns True when the cache appeared; False on timeout — meaning
+        ``data_dir`` is host-local (not shared with process 0), so the
+        caller should build its own per-host cache.
+        """
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cache_ready():
+                return True
+            time.sleep(0.5)
+        return False
 
     def _build_windows(self, r_stocks, r_market, verbose: bool):
         """Window + feature-expand + OLS-label pass, native engine preferred.

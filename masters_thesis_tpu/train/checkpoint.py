@@ -29,6 +29,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.utils import atomic_write_text
 
 
 def save_checkpoint(
@@ -61,9 +62,9 @@ def save_checkpoint(
     if jax.process_index() == 0:
         # Atomic publish: a crash mid-write must not leave a torn sidecar
         # (the auto-resume path reads it on restart).
-        tmp = ckpt_dir / f"{tag}.json.tmp"
-        tmp.write_text(json.dumps(sidecar, indent=2))
-        tmp.replace(ckpt_dir / f"{tag}.json")
+        atomic_write_text(
+            ckpt_dir / f"{tag}.json", json.dumps(sidecar, indent=2)
+        )
 
 
 def restore_checkpoint(

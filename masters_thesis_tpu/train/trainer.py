@@ -308,6 +308,7 @@ class Trainer:
         history: list[dict] = []
         total_steps = 0
         t_start = None  # set after first epoch (excludes compile)
+        diverged = False
 
         for epoch in range(start_epoch, self.max_epochs):
             if self.profile and epoch == start_epoch + 1:
@@ -329,7 +330,18 @@ class Trainer:
             row = {"epoch": epoch, "lr": scheduler.lr}
             row.update({f"loss/{k}/train": v for k, v in train_metrics.items()})
 
-            if (epoch + 1) % self.check_val_every_n_epoch == 0 and val_prepared:
+            # Failure detection: a non-finite training loss means the run has
+            # diverged — halt (after logging the poisoned row so TensorBoard
+            # shows WHY the curve ends) and do NOT publish the NaN params
+            # over the last good checkpoint. The reference has no such guard
+            # (SURVEY.md §5); Lightning would loop on NaN to the end.
+            diverged = not np.isfinite(row.get("loss/total/train", 0.0))
+
+            if (
+                not diverged
+                and (epoch + 1) % self.check_val_every_n_epoch == 0
+                and val_prepared
+            ):
                 val_sums = eval_fn(params, *val_prepared)
                 val_metrics = metric_means(jax.device_get(val_sums))
                 row.update({f"loss/{k}/val": v for k, v in val_metrics.items()})
@@ -359,6 +371,12 @@ class Trainer:
                     if k.startswith("loss/")
                 )
             )
+            if diverged:
+                self._print(
+                    f"epoch {epoch}: non-finite training loss "
+                    f"({row['loss/total/train']}); halting (diverged)"
+                )
+                break
 
         jax.block_until_ready(params)
         elapsed = time.perf_counter() - (t_start or time.perf_counter())
@@ -370,8 +388,10 @@ class Trainer:
         )
 
         # 'last' must hold the FINAL params even when the last epoch wasn't a
-        # val epoch (Lightning's save_last=True, train.py:159).
-        if self.ckpt_dir:
+        # val epoch (Lightning's save_last=True, train.py:159) — but a
+        # diverged run must NOT clobber the last good checkpoint with NaN
+        # params (auto-resume would then restart from poison).
+        if self.ckpt_dir and not diverged:
             self._save("last", params, opt_state, spec, self.max_epochs - 1,
                        best_val, dm, scheduler, best_val)
 

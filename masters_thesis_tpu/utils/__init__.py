@@ -1,0 +1,5 @@
+"""Shared host-side utilities."""
+
+from masters_thesis_tpu.utils.io import atomic_publish, atomic_write_text
+
+__all__ = ["atomic_publish", "atomic_write_text"]

@@ -1,0 +1,35 @@
+"""Crash-safe file publishing, in one place.
+
+Several subsystems (the dataset cache, checkpoint sidecars) rely on the same
+invariant: readers must never observe a torn file. The idiom is write-to-tmp
+then atomic rename; the tmp name is pid-suffixed so concurrent writers on a
+shared filesystem each use their own scratch file and the last rename wins
+with an intact artifact.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Iterator
+
+
+@contextmanager
+def atomic_publish(path: Path | str) -> Iterator[Path]:
+    """Yield a scratch path; on clean exit, atomically rename onto ``path``.
+
+    On exception the scratch file is removed and ``path`` is untouched.
+    """
+    path = Path(path)
+    tmp = path.with_name(f"{path.name}.tmp{os.getpid()}")
+    try:
+        yield tmp
+        tmp.replace(path)
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def atomic_write_text(path: Path | str, text: str) -> None:
+    with atomic_publish(path) as tmp:
+        tmp.write_text(text)

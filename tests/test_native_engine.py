@@ -85,6 +85,63 @@ def test_single_thread_matches_parallel(rng):
         np.testing.assert_array_equal(a[key], b[key])
 
 
+def test_multihost_nonwriter_waits_for_published_cache(rng, tmp_path, monkeypatch):
+    """Non-zero processes must poll for process 0's cache, not rebuild it."""
+    import threading
+
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+
+    stocks, market = _series(rng, k=3, t=400)
+    np.save(tmp_path / "stocks.npy", stocks)
+    np.save(tmp_path / "market.npy", market)
+    kw = dict(lookback_window=16, target_window=8, stride=24)
+
+    import jax
+
+    # This thread plays process 1 (non-writer); the spawned thread plays
+    # process 0 (the writer).
+    main_tid = threading.get_ident()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        jax,
+        "process_index",
+        lambda: 1 if threading.get_ident() == main_tid else 0,
+    )
+    dm = FinancialWindowDataModule(tmp_path, **kw)
+
+    # A writer publishing concurrently unblocks the wait.
+    writer_dm = FinancialWindowDataModule(tmp_path, **kw)
+    t = threading.Thread(
+        target=lambda: writer_dm.prepare_data(verbose=False)
+    )
+    t.start()
+    dm.prepare_data(verbose=False, cache_timeout_s=30.0)
+    t.join()
+    dm.setup()
+    assert dm.train_arrays().x.shape[-1] == 3
+
+
+def test_multihost_hostlocal_dir_builds_own_cache(rng, tmp_path, monkeypatch):
+    """A non-zero process whose data_dir is host-local (no shared writer)
+    must build its own per-host cache after the wait times out."""
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+
+    stocks, market = _series(rng, k=3, t=400)
+    np.save(tmp_path / "stocks.npy", stocks)
+    np.save(tmp_path / "market.npy", market)
+
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    dm = FinancialWindowDataModule(
+        tmp_path, lookback_window=16, target_window=8, stride=24
+    )
+    dm.prepare_data(verbose=False, cache_timeout_s=1.0)
+    dm.setup()
+    assert dm.train_arrays().x.shape[-1] == 3
+
+
 def test_datamodule_native_equals_python(rng, tmp_path):
     from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
 

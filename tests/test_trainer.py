@@ -183,6 +183,31 @@ class TestCheckpoint:
         ).fit(small_spec(), tiny_dm)
         assert noop.history == []
 
+    def test_divergence_halts_training(self, tmp_path):
+        """Failure detection: a non-finite train loss stops the run early
+        instead of looping through the remaining epochs."""
+        r_stocks, r_market, _, _ = SyntheticLogReturns.generate(
+            n_stocks=8, n_samples=4000, seed=1
+        )
+        stocks = np.array(r_stocks)
+        stocks[0, :200] = np.nan  # poisoned source series
+        np.save(tmp_path / "stocks.npy", stocks)
+        np.save(tmp_path / "market.npy", np.asarray(r_market))
+        dm = FinancialWindowDataModule(
+            tmp_path, lookback_window=16, target_window=8, stride=24,
+            batch_size=2,
+        )
+        dm.prepare_data(verbose=False)
+        dm.setup()
+        ckpt_dir = tmp_path / "ckpts"
+        result = make_trainer(max_epochs=5, ckpt_dir=ckpt_dir).fit(
+            small_spec(), dm
+        )
+        assert len(result.history) == 1
+        assert not np.isfinite(result.history[0]["loss/total/train"])
+        # The diverged run must not publish NaN params as 'last'.
+        assert not (ckpt_dir / "last").exists()
+
     def test_restored_params_reproduce_test_metrics(self, tiny_dm, tmp_path):
         ckpt_dir = tmp_path / "ckpts"
         trainer = make_trainer(ckpt_dir=ckpt_dir, max_epochs=2)
