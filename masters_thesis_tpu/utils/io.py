@@ -2,14 +2,15 @@
 
 Several subsystems (the dataset cache, checkpoint sidecars) rely on the same
 invariant: readers must never observe a torn file. The idiom is write-to-tmp
-then atomic rename; the tmp name is pid-suffixed so concurrent writers on a
-shared filesystem each use their own scratch file and the last rename wins
-with an intact artifact.
+then atomic rename; the tmp name carries a uuid (pids alone are only unique
+per host) so concurrent writers — including processes on different hosts
+sharing a filesystem — each use their own scratch file and the last rename
+wins with an intact artifact.
 """
 
 from __future__ import annotations
 
-import os
+import uuid
 from contextlib import contextmanager
 from pathlib import Path
 from typing import Iterator
@@ -22,7 +23,7 @@ def atomic_publish(path: Path | str) -> Iterator[Path]:
     On exception the scratch file is removed and ``path`` is untouched.
     """
     path = Path(path)
-    tmp = path.with_name(f"{path.name}.tmp{os.getpid()}")
+    tmp = path.with_name(f"{path.name}.tmp{uuid.uuid4().hex[:12]}")
     try:
         yield tmp
         tmp.replace(path)
