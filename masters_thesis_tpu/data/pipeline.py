@@ -52,24 +52,45 @@ class Batch(NamedTuple):
 
 
 def bootstrap_synthetic(
-    data_dir: Path, n_stocks: int = 100, n_samples: int = 1_000_000, seed: int = 0
+    data_dir: Path,
+    n_stocks: int = 100,
+    n_samples: int = 1_000_000,
+    seed: int = 0,
+    variant: str = "no_outliers",
 ) -> None:
     """Generate and save the synthetic market history if not already present.
 
     Mirrors the reference's first-run bootstrap (reference: train.py:30-36)
-    with an explicit seed instead of torch global RNG state.
+    with an explicit seed instead of torch global RNG state. A ``dgp.json``
+    sidecar records the generation parameters; re-bootstrapping the same
+    ``data_dir`` with different parameters is an error, not a silent reuse
+    of the stale arrays.
     """
     data_dir = Path(data_dir)
+    requested = {
+        "n_stocks": n_stocks, "n_samples": n_samples, "seed": seed,
+        "variant": variant,
+    }
+    meta_file = data_dir / "dgp.json"
     if data_dir.exists() and (data_dir / "stocks.npy").exists():
+        if meta_file.exists():
+            existing = json.loads(meta_file.read_text())
+            if existing != requested:
+                raise ValueError(
+                    f"{data_dir} holds a synthetic dataset generated with "
+                    f"{existing}, but {requested} was requested — use a "
+                    "different data_dir or delete the old arrays"
+                )
         return
     data_dir.mkdir(parents=True, exist_ok=True)
     r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
-        n_stocks, n_samples, seed
+        n_stocks, n_samples, seed, variant=variant
     )
     np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
     np.save(data_dir / "market.npy", np.asarray(r_market))
     np.save(data_dir / "alphas.npy", np.asarray(alphas))
     np.save(data_dir / "betas.npy", np.asarray(betas))
+    atomic_write_text(meta_file, json.dumps(requested, indent=2))
 
 
 def bootstrap_real(raw_dir: Path, data_dir: Path) -> bool:

@@ -38,8 +38,8 @@ class SyntheticLogReturns:
     alpha_params = {"loc": 0.0098, "scale": 0.1271}  # Normal
     beta_params = {"loc": 0.9444, "scale": 0.3521}  # Normal
 
-    # Alternative estimate including outlier days (kept unused by the
-    # reference as well, src/data.py:41-47).
+    # Alternative estimate including outlier days (the reference keeps these
+    # in a comment, src/data.py:41-47; here they are a selectable variant).
     mkt_params_outliers = {"loc": 0.0538, "scale": 0.6616, "df": 5.0}
     idio_params_outliers = {"loc": 0.0000, "scale": 0.3539, "df": 5.0}
     alpha_params_outliers = {"loc": 0.0056, "scale": 0.1501}
@@ -47,26 +47,39 @@ class SyntheticLogReturns:
 
     @staticmethod
     def generate(
-        n_stocks: int, n_samples: int, seed: int = 0
+        n_stocks: int,
+        n_samples: int,
+        seed: int = 0,
+        variant: str = "no_outliers",
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Sample one synthetic market history under an explicit seed."""
+        """Sample one synthetic market history under an explicit seed.
+
+        ``variant``: ``"no_outliers"`` (reference default) or ``"outliers"``
+        (parameters estimated including outlier days).
+        """
         rng = np.random.default_rng(seed)
         p = SyntheticLogReturns
+        if variant == "no_outliers":
+            mkt, idio = p.mkt_params, p.idio_params
+            alpha_p, beta_p = p.alpha_params, p.beta_params
+        elif variant == "outliers":
+            mkt, idio = p.mkt_params_outliers, p.idio_params_outliers
+            alpha_p, beta_p = p.alpha_params_outliers, p.beta_params_outliers
+        else:
+            raise ValueError(f"unknown DGP variant: {variant!r}")
 
         def student_t(params, shape):
             return (
                 params["loc"] + params["scale"] * rng.standard_t(params["df"], shape)
             ).astype(np.float32)
 
-        r_market = student_t(p.mkt_params, (n_samples,))
-        r_idio = student_t(p.idio_params, (n_stocks, n_samples))
+        r_market = student_t(mkt, (n_samples,))
+        r_idio = student_t(idio, (n_stocks, n_samples))
         alphas = (
-            p.alpha_params["loc"]
-            + p.alpha_params["scale"] * rng.standard_normal(n_stocks)
+            alpha_p["loc"] + alpha_p["scale"] * rng.standard_normal(n_stocks)
         ).astype(np.float32)
         betas = (
-            p.beta_params["loc"]
-            + p.beta_params["scale"] * rng.standard_normal(n_stocks)
+            beta_p["loc"] + beta_p["scale"] * rng.standard_normal(n_stocks)
         ).astype(np.float32)
 
         r_systematic = alphas[:, None] + betas[:, None] * r_market[None, :]

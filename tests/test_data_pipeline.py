@@ -151,3 +151,17 @@ def test_teardown_cleanup_removes_cache(synth_dir):
     assert (synth_dir / "datasets" / "dataset.npz").exists()
     dm.teardown("cleanup")
     assert not (synth_dir / "datasets").exists()
+
+
+def test_bootstrap_rejects_mismatched_dgp_params(tmp_path):
+    """Re-bootstrapping a data_dir with different DGP parameters must fail
+    loudly instead of silently reusing the stale arrays."""
+    from masters_thesis_tpu.data.pipeline import bootstrap_synthetic
+
+    bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
+    # Same params: idempotent.
+    bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
+    with pytest.raises(ValueError, match="different data_dir"):
+        bootstrap_synthetic(
+            tmp_path, n_stocks=4, n_samples=500, seed=0, variant="outliers"
+        )

@@ -2,6 +2,7 @@
 contract — exact sample parity with torch RNG is impossible, SURVEY.md §7)."""
 
 import numpy as np
+import pytest
 
 from masters_thesis_tpu.data import SyntheticLogReturns
 
@@ -51,3 +52,17 @@ def test_factor_structure_regression_recovers_beta():
     np.testing.assert_allclose(beta_hat, betas, atol=0.05)
     alpha_hat = s.mean(1) - beta_hat * m.mean()
     np.testing.assert_allclose(alpha_hat, alphas, atol=0.05)
+
+
+def test_outliers_variant_differs_and_matches_params():
+    """The outliers variant is selectable and produces wider-tailed data."""
+    r_s, r_m, a, b = SyntheticLogReturns.generate(
+        32, 200_000, seed=0, variant="outliers"
+    )
+    p = SyntheticLogReturns
+    assert np.mean(b) == pytest.approx(p.beta_params_outliers["loc"], abs=0.2)
+    # t(5) with the outliers scale has a larger market std than the default.
+    _, r_m0, _, _ = SyntheticLogReturns.generate(32, 200_000, seed=0)
+    assert np.std(r_m) > np.std(r_m0)
+    with pytest.raises(ValueError):
+        SyntheticLogReturns.generate(4, 100, variant="bogus")

@@ -67,7 +67,7 @@ def test_recovers_planted_coefficients(trained):
 
 
 def test_trained_model_delta_loss_near_ols(trained):
-    """On the thesis' ΔL scale, brief MSE training must land within ~2x of
+    """On the thesis' ΔL scale, brief MSE training must land within 3x of
     the lookback-OLS row (both above the target-OLS baseline by
     construction)."""
     spec, result, dm = trained

@@ -56,6 +56,7 @@ def bootstrap(cfg: Config) -> bool:
             n_stocks=dmcfg.n_stocks,
             n_samples=dmcfg.n_samples,
             seed=cfg.seed,
+            variant=dmcfg.get("dgp_variant", "no_outliers"),
         )
         return True
     if not bootstrap_real(Path(dmcfg.raw_dir), Path(dmcfg.data_dir)):
