@@ -71,16 +71,19 @@ def bootstrap_synthetic(
         "n_stocks": n_stocks, "n_samples": n_samples, "seed": seed,
         "variant": variant,
     }
+    # dgp.json is the COMPLETION marker (written last, atomically): a dir
+    # with arrays but no sidecar is a torn or legacy bootstrap and gets
+    # regenerated — generation is seed-deterministic, so rebuilding a legacy
+    # dir reproduces the same arrays.
     meta_file = data_dir / "dgp.json"
-    if data_dir.exists() and (data_dir / "stocks.npy").exists():
-        if meta_file.exists():
-            existing = json.loads(meta_file.read_text())
-            if existing != requested:
-                raise ValueError(
-                    f"{data_dir} holds a synthetic dataset generated with "
-                    f"{existing}, but {requested} was requested — use a "
-                    "different data_dir or delete the old arrays"
-                )
+    if meta_file.exists() and (data_dir / "stocks.npy").exists():
+        existing = json.loads(meta_file.read_text())
+        if existing != requested:
+            raise ValueError(
+                f"{data_dir} holds a synthetic dataset generated with "
+                f"{existing}, but {requested} was requested — use a "
+                "different data_dir or delete the old dataset"
+            )
         return
     data_dir.mkdir(parents=True, exist_ok=True)
     r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
@@ -156,17 +159,34 @@ class FinancialWindowDataModule:
         return 3 if self.interaction_only else 5
 
     def _hparams_hash(self) -> str:
-        """SHA-256 over the window hyperparameters (reference: src/data.py:166-175)."""
+        """SHA-256 over the window hyperparameters AND a source fingerprint.
+
+        (Reference: src/data.py:166-175 hashes only the window hparams —
+        which goes stale silently if the source arrays are regenerated, e.g.
+        with a different DGP variant. Including each source file's size +
+        mtime and the dgp.json sidecar makes the windowed cache rebuild
+        whenever its inputs change.)
+        """
         hparams = {
             "lookback_window": self.lookback_window,
             "target_window": self.target_window,
             "stride": self.stride,
             "prediction_task": self.prediction_task,
             "interaction_only": self.interaction_only,
+            "source": self._source_fingerprint(),
         }
         return hashlib.sha256(
             json.dumps(hparams, sort_keys=True).encode()
         ).hexdigest()
+
+    def _source_fingerprint(self) -> list:
+        fingerprint: list = []
+        for name in ("stocks.npy", "market.npy", "dgp.json"):
+            path = self.data_dir / name
+            if path.exists():
+                stat = path.stat()
+                fingerprint.append([name, stat.st_size, stat.st_mtime_ns])
+        return fingerprint
 
     @property
     def _datasets_dir(self) -> Path:

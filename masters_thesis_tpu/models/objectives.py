@@ -30,9 +30,8 @@ import jax.numpy as jnp
 from jax import Array
 
 from masters_thesis_tpu.ops import (
-    inverse_returns_covariance,
     mean_squared_error,
-    multivariate_gaussian_nll,
+    single_factor_gaussian_nll,
 )
 
 # (loss, metric sums) for one window; metric sums are psum/accumulation-ready
@@ -58,12 +57,13 @@ def nll_window(
     alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array
 ) -> tuple[Array, dict]:
     """Multivariate-Gaussian NLL with single-factor Woodbury inverse
-    covariance (reference: src/model.py:234-249)."""
+    covariance (reference: src/model.py:234-249), computed via the fused
+    O(K·n) form (ops/losses.py single_factor_gaussian_nll) instead of
+    materializing the K×K inverse covariance."""
     r_target = y[:, :, 0]
     f_mean, f_var = factor[0], factor[1]
     r_mean = alpha + beta * f_mean  # (K, 1)
-    inv_cov = inverse_returns_covariance(beta, jnp.diag(inv_psi), f_var)
-    loss = multivariate_gaussian_nll(r_mean, inv_cov, r_target)
+    loss = single_factor_gaussian_nll(r_mean, beta, inv_psi, f_var, r_target)
     return loss, {"nll": (loss, jnp.float32(1.0))}
 
 

@@ -14,6 +14,7 @@ from masters_thesis_tpu.ops.windows import (
 )
 from masters_thesis_tpu.ops.losses import (
     multivariate_gaussian_nll,
+    single_factor_gaussian_nll,
     mean_squared_error,
     LOG_2PI,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "add_quadratic_features",
     "ols_features",
     "multivariate_gaussian_nll",
+    "single_factor_gaussian_nll",
     "mean_squared_error",
     "LOG_2PI",
 ]

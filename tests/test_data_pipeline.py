@@ -165,3 +165,47 @@ def test_bootstrap_rejects_mismatched_dgp_params(tmp_path):
         bootstrap_synthetic(
             tmp_path, n_stocks=4, n_samples=500, seed=0, variant="outliers"
         )
+
+
+def test_bootstrap_heals_torn_or_legacy_dir(tmp_path):
+    """Arrays without the dgp.json completion marker (torn bootstrap or a
+    pre-sidecar dataset) are regenerated, not trusted."""
+    from masters_thesis_tpu.data.pipeline import bootstrap_synthetic
+
+    np.save(tmp_path / "stocks.npy", np.zeros((2, 50), np.float32))  # torn
+    bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
+    assert (tmp_path / "dgp.json").exists()
+    assert np.load(tmp_path / "stocks.npy").shape == (4, 500)
+
+
+def test_window_cache_rebuilds_when_source_changes(tmp_path):
+    """The windowed-dataset cache must track the SOURCE arrays, not just the
+    window hyperparameters (silent-staleness guard)."""
+    import time
+
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+
+    kw = dict(lookback_window=8, target_window=4, stride=12)
+    bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
+    dm = FinancialWindowDataModule(tmp_path, **kw)
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    before = np.array(dm.train_arrays().x)
+
+    # Regenerate the source with a different DGP; same window hparams.
+    for name in ("stocks.npy", "market.npy", "alphas.npy", "betas.npy",
+                 "dgp.json"):
+        (tmp_path / name).unlink()
+    time.sleep(0.01)  # ensure a distinct mtime on coarse filesystems
+    bootstrap_synthetic(
+        tmp_path, n_stocks=4, n_samples=500, seed=1, variant="outliers"
+    )
+    dm2 = FinancialWindowDataModule(tmp_path, **kw)
+    dm2.prepare_data(verbose=False)
+    dm2.setup()
+    after = np.array(dm2.train_arrays().x)
+    assert before.shape == after.shape
+    assert not np.allclose(before, after)

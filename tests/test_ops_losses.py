@@ -62,3 +62,60 @@ def test_mse_matches_numpy(rng):
     b = rng.normal(size=(10, 3))
     got = mean_squared_error(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32))
     np.testing.assert_allclose(float(got), ((a - b) ** 2).mean(), rtol=1e-5)
+
+
+class TestSingleFactorFusedNll:
+    """The fused O(K*n) NLL must match the dense Woodbury+slogdet path."""
+
+    def _random_inputs(self, rng, k=50, n=30):
+        mean = rng.normal(size=(k, 1)).astype(np.float32)
+        beta = rng.normal(1.0, 0.3, size=(k, 1)).astype(np.float32)
+        inv_psi = rng.uniform(0.5, 5.0, size=(k,)).astype(np.float32)
+        f_var = np.float32(rng.uniform(0.1, 2.0))
+        target = rng.normal(size=(k, n)).astype(np.float32)
+        return mean, beta, inv_psi, f_var, target
+
+    def test_matches_dense_path(self, rng):
+        from masters_thesis_tpu.ops import (
+            inverse_returns_covariance,
+            multivariate_gaussian_nll,
+            single_factor_gaussian_nll,
+        )
+
+        for _ in range(5):
+            mean, beta, inv_psi, f_var, target = self._random_inputs(rng)
+            dense = multivariate_gaussian_nll(
+                mean,
+                inverse_returns_covariance(beta, jnp.diag(inv_psi), f_var),
+                target,
+            )
+            fused = single_factor_gaussian_nll(
+                mean, beta, inv_psi, f_var, target
+            )
+            np.testing.assert_allclose(
+                float(fused), float(dense), rtol=2e-4
+            )
+
+    def test_non_psd_inputs_yield_nan(self, rng):
+        from masters_thesis_tpu.ops import single_factor_gaussian_nll
+
+        mean, beta, inv_psi, f_var, target = self._random_inputs(rng, k=8)
+        inv_psi[2] = -1.0  # one non-positive idiosyncratic precision
+        out = single_factor_gaussian_nll(mean, beta, inv_psi, f_var, target)
+        assert np.isnan(float(out))
+
+    def test_gradients_finite(self, rng):
+        import jax
+
+        from masters_thesis_tpu.ops import single_factor_gaussian_nll
+
+        mean, beta, inv_psi, f_var, target = self._random_inputs(rng, k=12)
+
+        def loss(mean, beta):
+            return single_factor_gaussian_nll(
+                mean, beta, inv_psi, f_var, target
+            )
+
+        g_mean, g_beta = jax.grad(loss, argnums=(0, 1))(mean, beta)
+        assert np.isfinite(np.asarray(g_mean)).all()
+        assert np.isfinite(np.asarray(g_beta)).all()

@@ -51,11 +51,14 @@ def bootstrap(cfg: Config) -> bool:
 
     dmcfg = cfg.datamodule
     if dmcfg.name == "synthetic":
+        # The DGP seed is its own key (default 0), NOT cfg.seed: sweeping the
+        # training seed must not invalidate (or conflict with) a shared
+        # bootstrapped dataset.
         bootstrap_synthetic(
             Path(dmcfg.data_dir),
             n_stocks=dmcfg.n_stocks,
             n_samples=dmcfg.n_samples,
-            seed=cfg.seed,
+            seed=dmcfg.get("dgp_seed", 0),
             variant=dmcfg.get("dgp_variant", "no_outliers"),
         )
         return True
