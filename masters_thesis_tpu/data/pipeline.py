@@ -62,21 +62,23 @@ def bootstrap_synthetic(
 
     Mirrors the reference's first-run bootstrap (reference: train.py:30-36)
     with an explicit seed instead of torch global RNG state. A ``dgp.json``
-    sidecar records the generation parameters; re-bootstrapping the same
-    ``data_dir`` with different parameters is an error, not a silent reuse
-    of the stale arrays.
+    sidecar records the generation parameters and acts as the COMPLETION
+    marker (written last, atomically); re-bootstrapping the same
+    ``data_dir`` with different parameters — or over arrays missing the
+    sidecar (torn/unknown provenance) — is an error, not a silent reuse or
+    overwrite. Multi-host: process 0 generates, the rest wait for the
+    marker (host-local dirs fall back to generating after the wait).
     """
     data_dir = Path(data_dir)
     requested = {
         "n_stocks": n_stocks, "n_samples": n_samples, "seed": seed,
         "variant": variant,
     }
-    # dgp.json is the COMPLETION marker (written last, atomically): a dir
-    # with arrays but no sidecar is a torn or legacy bootstrap and gets
-    # regenerated — generation is seed-deterministic, so rebuilding a legacy
-    # dir reproduces the same arrays.
     meta_file = data_dir / "dgp.json"
-    if meta_file.exists() and (data_dir / "stocks.npy").exists():
+
+    def check_existing() -> bool:
+        if not (meta_file.exists() and (data_dir / "stocks.npy").exists()):
+            return False
         existing = json.loads(meta_file.read_text())
         if existing != requested:
             raise ValueError(
@@ -84,15 +86,38 @@ def bootstrap_synthetic(
                 f"{existing}, but {requested} was requested — use a "
                 "different data_dir or delete the old dataset"
             )
+        return True
+
+    if check_existing():
         return
+    if (data_dir / "stocks.npy").exists():
+        raise ValueError(
+            f"{data_dir} contains arrays without a dgp.json sidecar (torn "
+            "bootstrap or pre-sidecar dataset of unknown provenance) — "
+            "delete the directory to regenerate"
+        )
+
+    import jax
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # Shared dir: wait for process 0's marker; host-local: generate.
+        if FinancialWindowDataModule._wait_for_cache(check_existing, 600.0):
+            return
+
     data_dir.mkdir(parents=True, exist_ok=True)
     r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
         n_stocks, n_samples, seed, variant=variant
     )
-    np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
-    np.save(data_dir / "market.npy", np.asarray(r_market))
-    np.save(data_dir / "alphas.npy", np.asarray(alphas))
-    np.save(data_dir / "betas.npy", np.asarray(betas))
+    arrays = {
+        "stocks.npy": r_stocks, "market.npy": r_market,
+        "alphas.npy": alphas, "betas.npy": betas,
+    }
+    for name, arr in arrays.items():
+        # Atomic per-file publish: concurrent same-params writers (parallel
+        # sweep jobs sharing a data_dir) never expose a torn .npy.
+        with atomic_publish(data_dir / name) as tmp:
+            with open(tmp, "wb") as f:
+                np.save(f, np.asarray(arr))
     atomic_write_text(meta_file, json.dumps(requested, indent=2))
 
 
@@ -180,12 +205,22 @@ class FinancialWindowDataModule:
         ).hexdigest()
 
     def _source_fingerprint(self) -> list:
+        """Content-based source identity: size + head-of-file digest.
+
+        Deliberately NOT mtime-based — mtimes differ across hosts writing a
+        shared dir, which would break the multi-host cache rendezvous. The
+        first 64 KiB covers the npy header (shape/dtype) plus a content
+        sample, so regenerating with a different DGP changes the key while
+        byte-identical regeneration doesn't.
+        """
         fingerprint: list = []
         for name in ("stocks.npy", "market.npy", "dgp.json"):
             path = self.data_dir / name
             if path.exists():
-                stat = path.stat()
-                fingerprint.append([name, stat.st_size, stat.st_mtime_ns])
+                with open(path, "rb") as f:
+                    head = f.read(65536)
+                digest = hashlib.sha256(head).hexdigest()[:16]
+                fingerprint.append([name, path.stat().st_size, digest])
         return fingerprint
 
     @property

@@ -1,5 +1,12 @@
 """Shared host-side utilities."""
 
+from masters_thesis_tpu.utils.compilation_cache import (
+    enable_persistent_compilation_cache,
+)
 from masters_thesis_tpu.utils.io import atomic_publish, atomic_write_text
 
-__all__ = ["atomic_publish", "atomic_write_text"]
+__all__ = [
+    "atomic_publish",
+    "atomic_write_text",
+    "enable_persistent_compilation_cache",
+]

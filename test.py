@@ -52,6 +52,9 @@ def main(argv: list[str] | None = None) -> None:
 
     from masters_thesis_tpu.evaluation import collect_test_results, delta_losses
     from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+    from masters_thesis_tpu.utils import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     from masters_thesis_tpu.train.logging import TensorBoardLogger
     from masters_thesis_tpu.viz import (
         estimation_plots,

@@ -167,15 +167,18 @@ def test_bootstrap_rejects_mismatched_dgp_params(tmp_path):
         )
 
 
-def test_bootstrap_heals_torn_or_legacy_dir(tmp_path):
+def test_bootstrap_refuses_unmarked_arrays(tmp_path):
     """Arrays without the dgp.json completion marker (torn bootstrap or a
-    pre-sidecar dataset) are regenerated, not trusted."""
+    dataset of unknown provenance) are refused loudly, never overwritten or
+    trusted."""
     from masters_thesis_tpu.data.pipeline import bootstrap_synthetic
 
-    np.save(tmp_path / "stocks.npy", np.zeros((2, 50), np.float32))  # torn
-    bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
-    assert (tmp_path / "dgp.json").exists()
-    assert np.load(tmp_path / "stocks.npy").shape == (4, 500)
+    sentinel = np.zeros((2, 50), np.float32)
+    np.save(tmp_path / "stocks.npy", sentinel)  # torn / pre-sidecar
+    with pytest.raises(ValueError, match="sidecar"):
+        bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
+    # The unmarked arrays were not touched.
+    assert np.load(tmp_path / "stocks.npy").shape == sentinel.shape
 
 
 def test_window_cache_rebuilds_when_source_changes(tmp_path):

@@ -110,6 +110,10 @@ def run(cfg: Config) -> float:
     objective the reference returns at train.py:220)."""
     from masters_thesis_tpu.train import Trainer
     from masters_thesis_tpu.train.logging import TensorBoardLogger
+    from masters_thesis_tpu.utils import enable_persistent_compilation_cache
+
+    # Sweep jobs after the first skip the multi-second XLA compiles.
+    enable_persistent_compilation_cache()
 
     # Multi-host single-job training: initialize the JAX distributed runtime
     # first so every host sees the global device mesh (replaces Lightning's
