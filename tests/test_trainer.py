@@ -183,6 +183,18 @@ class TestCheckpoint:
         ).fit(small_spec(), tiny_dm)
         assert noop.history == []
 
+    def test_bf16_mixed_precision_trains(self, tiny_dm):
+        """precision='bf16-mixed' (LSTM recurrence in bfloat16 on the MXU,
+        f32 params and loss math) must train to a loss comparable to f32."""
+        r32 = make_trainer(max_epochs=2).fit(small_spec(), tiny_dm)
+        rbf = make_trainer(max_epochs=2, precision="bf16-mixed").fit(
+            small_spec(), tiny_dm
+        )
+        a = r32.history[-1]["loss/total/train"]
+        b = rbf.history[-1]["loss/total/train"]
+        assert np.isfinite(b)
+        assert abs(a - b) / max(abs(a), 1e-9) < 0.1
+
     def test_divergence_halts_training(self, tmp_path):
         """Failure detection: a non-finite train loss stops the run early
         instead of looping through the remaining epochs."""
