@@ -141,6 +141,7 @@ def run(cfg: Config) -> float:
         check_val_every_n_epoch=t.get("check_val_every_n_epoch", 1),
         strategy=t.strategy,
         epoch_mode=t.epoch_mode,
+        n_devices=t.get("n_devices", None),
         enable_progress_bar=t.enable_progress_bar,
         enable_model_summary=t.enable_model_summary,
         profile=t.get("profile", False),
