@@ -27,7 +27,7 @@ import numpy as np
 
 from masters_thesis_tpu.data.fama_french import FamaFrench25Portfolios
 from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
-from masters_thesis_tpu.utils import atomic_publish, atomic_write_text
+from masters_thesis_tpu.utils import atomic_publish, atomic_write_text, wait_until
 from masters_thesis_tpu.ops import (
     add_quadratic_features,
     lookback_target_split,
@@ -101,7 +101,7 @@ def bootstrap_synthetic(
 
     if jax.process_count() > 1 and jax.process_index() != 0:
         # Shared dir: wait for process 0's marker; host-local: generate.
-        if FinancialWindowDataModule._wait_for_cache(check_existing, 600.0):
+        if wait_until(check_existing, 600.0):
             return
 
     data_dir.mkdir(parents=True, exist_ok=True)
@@ -263,7 +263,7 @@ class FinancialWindowDataModule:
                 print("Dataset parameters unchanged, skipping data preparation")
             return
         if jax.process_count() > 1 and jax.process_index() != 0:
-            if self._wait_for_cache(cache_ready, cache_timeout_s):
+            if wait_until(cache_ready, cache_timeout_s):
                 return
             if verbose:
                 print(
@@ -312,23 +312,6 @@ class FinancialWindowDataModule:
                     inv_psi=np.asarray(t_inv_psi),
                 )
         atomic_write_text(hash_file, hparams_hash)
-
-    @staticmethod
-    def _wait_for_cache(cache_ready, timeout_s: float) -> bool:
-        """Non-writer processes poll for process 0's published cache.
-
-        Returns True when the cache appeared; False on timeout — meaning
-        ``data_dir`` is host-local (not shared with process 0), so the
-        caller should build its own per-host cache.
-        """
-        import time
-
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if cache_ready():
-                return True
-            time.sleep(0.5)
-        return False
 
     def _build_windows(self, r_stocks, r_market, verbose: bool):
         """Window + feature-expand + OLS-label pass, native engine preferred.

@@ -339,6 +339,15 @@ class Trainer:
                 f"({row['loss/total/train']}); halting (diverged)"
             )
 
+        def drain(pend) -> bool:
+            """Readback + emit a deferred epoch; True = diverged (halted)."""
+            row, sums = pend
+            bad = readback(row, sums)
+            emit(row)
+            if bad:
+                halt(row)
+            return bad
+
         trace_open = False
         for epoch in range(start_epoch, self.max_epochs):
             if self.profile and epoch == start_epoch + 1:
@@ -357,12 +366,9 @@ class Trainer:
 
             # Previous epoch's readback overlaps this epoch's execution.
             if pending is not None:
-                prev_row, prev_sums = pending
-                pending = None
-                diverged = readback(prev_row, prev_sums)
-                emit(prev_row)
+                prev, pending = pending, None
+                diverged = drain(prev)
                 if diverged:
-                    halt(prev_row)
                     break
 
             is_val = (
@@ -407,11 +413,7 @@ class Trainer:
             jax.profiler.stop_trace()
 
         if pending is not None and not diverged:
-            prev_row, prev_sums = pending
-            diverged = readback(prev_row, prev_sums)
-            emit(prev_row)
-            if diverged:
-                halt(prev_row)
+            diverged = drain(pending)
 
         jax.block_until_ready(params)
         elapsed = time.perf_counter() - (t_start or time.perf_counter())

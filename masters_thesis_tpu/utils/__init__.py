@@ -3,10 +3,15 @@
 from masters_thesis_tpu.utils.compilation_cache import (
     enable_persistent_compilation_cache,
 )
-from masters_thesis_tpu.utils.io import atomic_publish, atomic_write_text
+from masters_thesis_tpu.utils.io import (
+    atomic_publish,
+    atomic_write_text,
+    wait_until,
+)
 
 __all__ = [
     "atomic_publish",
     "atomic_write_text",
     "enable_persistent_compilation_cache",
+    "wait_until",
 ]

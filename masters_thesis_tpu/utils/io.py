@@ -34,3 +34,19 @@ def atomic_publish(path: Path | str) -> Iterator[Path]:
 def atomic_write_text(path: Path | str, text: str) -> None:
     with atomic_publish(path) as tmp:
         tmp.write_text(text)
+
+
+def wait_until(predicate, timeout_s: float, interval_s: float = 0.5) -> bool:
+    """Poll ``predicate`` until it returns True; False on timeout.
+
+    Used by multi-process rendezvous (non-writer processes waiting for a
+    writer's atomically-published artifact to appear).
+    """
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
