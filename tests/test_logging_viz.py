@@ -1,0 +1,95 @@
+"""TensorBoard logger round-trip + figure library behavior.
+
+The reference gets these behaviors from Lightning's TensorBoardLogger and
+eyeballs the figures (reference: train.py:143-148, src/plots.py); here both
+are owned code, so both get tests: scalars written must be readable back out
+of the event files, and each figure kind must carry its statistical
+annotations.
+"""
+
+import numpy as np
+import pytest
+from tensorboard.backend.event_processing.event_accumulator import (
+    EventAccumulator,
+)
+
+from masters_thesis_tpu.train.logging import TensorBoardLogger
+from masters_thesis_tpu.viz import (
+    estimation_plots,
+    estimation_scatter,
+    hist_plot,
+    scatter_plot,
+)
+
+
+def _read_scalars(log_dir):
+    acc = EventAccumulator(str(log_dir))
+    acc.Reload()
+    return {
+        tag: [(e.step, e.value) for e in acc.Scalars(tag)]
+        for tag in acc.Tags()["scalars"]
+    }
+
+
+class TestTensorBoardLogger:
+    def test_scalar_roundtrip(self, tmp_path):
+        tb = TensorBoardLogger(tmp_path, "name/sub", "v0")
+        tb.log_scalars({"loss/total/train": 1.5, "lr": 0.1}, step=0)
+        tb.log_scalar("loss/total/train", 1.25, step=1)
+        tb.close()
+        assert tb.log_dir == tmp_path / "name" / "sub" / "v0"
+        scalars = _read_scalars(tb.log_dir)
+        assert [v for _, v in scalars["loss/total/train"]] == [1.5, 1.25]
+        assert scalars["lr"][0] == (0, pytest.approx(0.1))
+
+    def test_hparams_and_figures_write_events(self, tmp_path):
+        tb = TensorBoardLogger(tmp_path, "n", "v")
+        tb.log_hparams(
+            {"model.hidden_size": 64, "loss.name": "mse", "none": None},
+            {"test/mae": 0.5},
+        )
+        fig = scatter_plot(np.arange(10.0), np.arange(10.0), title="t")
+        tb.log_figure("scatter/x", fig)
+        tb.close()
+        acc = EventAccumulator(str(tb.log_dir))
+        acc.Reload()
+        assert acc.Tags()["images"]  # the figure landed
+        event_files = list(tb.log_dir.rglob("events.out.tfevents.*"))
+        assert len(event_files) >= 2  # main + hparams sub-run
+
+
+class TestFigures:
+    def test_scatter_has_identity_and_corr(self):
+        a = np.linspace(0, 1, 50)
+        fig = scatter_plot(a, a, title="Alphas")
+        ax = fig.axes[0]
+        assert "corr=1.0000" in ax.get_title()
+        assert len(ax.lines) == 1  # identity line
+
+    def test_hist_bins_scale_with_samples(self):
+        data = np.random.default_rng(0).normal(size=1000)
+        fig = hist_plot(data, data + 1, title="resid")
+        ax = fig.axes[0]
+        # bins = 1% of n + 1 (reference: src/plots.py:30-54).
+        assert len(ax.patches) == 2 * (int(1000 * 0.01) + 1)
+        assert len(ax.get_legend().get_texts()) >= 2
+
+    def test_estimation_scatter_two_panels(self):
+        rng = np.random.default_rng(1)
+        t = rng.normal(size=(40, 3))
+        fig = estimation_scatter(t + 0.1 * rng.normal(size=t.shape), t, t)
+        assert len(fig.axes) == 2
+
+    def test_estimation_plots_caps_at_nine_stocks(self, tmp_path):
+        tb = TensorBoardLogger(tmp_path, "n", "v")
+        n_win, n_stocks = 20, 12
+        rng = np.random.default_rng(2)
+        ests = rng.normal(size=(n_win, n_stocks))
+        estimation_plots(tb, ests, ests, ests, est_kind="beta")
+        tb.close()
+        # size_guidance images=0 -> keep all (the default caps at 4)
+        acc = EventAccumulator(str(tb.log_dir), size_guidance={"images": 0})
+        acc.Reload()
+        # one figure per stock, first <=9 stocks only (src/plots.py:56-76)
+        imgs = acc.Images("estimation/examples_beta")
+        assert len(imgs) == 9
