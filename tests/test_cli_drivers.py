@@ -1,0 +1,71 @@
+"""End-to-end CLI driver tests: train.py -> checkpoint -> test.py figures.
+
+The reference's drivers are only ever exercised by hand (SURVEY.md §4); here
+the full CLI surface — config composition, bootstrap, training, checkpoint
+layout, eval driver, figure/delta-loss output — runs in-process on the
+virtual CPU platform.
+"""
+
+import numpy as np
+import pytest
+from tensorboard.backend.event_processing.event_accumulator import (
+    EventAccumulator,
+)
+
+import test as test_mod
+import train as train_mod
+
+
+@pytest.fixture(scope="module")
+def cli_run(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cli")
+    overrides = [
+        "trainer=fast",
+        "trainer.max_epochs=2",
+        "trainer.enable_progress_bar=false",
+        "trainer.enable_model_summary=false",
+        "model.hidden_size=8",
+        "model.num_layers=1",
+        "datamodule.n_samples=20000",
+        "datamodule.n_stocks=6",
+        f"datamodule.data_dir={root}/data",
+        f"logger.save_dir={root}/logs",
+        "logger.version=cli_test",
+    ]
+    return root, overrides
+
+
+def test_train_cli_end_to_end(cli_run):
+    root, overrides = cli_run
+    train_mod.main(overrides)
+    version_dir = root / "logs" / "FinancialLstm" / "synthetic" / "cli_test"
+    assert (version_dir / "checkpoints" / "best").exists()
+    assert (version_dir / "checkpoints" / "last.json").exists()
+    assert list(version_dir.glob("events.out.tfevents.*"))
+
+
+def test_eval_cli_renders_figures_and_deltas(cli_run, capsys):
+    root, overrides = cli_run
+    ckpt = root / "logs" / "FinancialLstm" / "synthetic" / "cli_test"
+    ckpt = ckpt / "checkpoints" / "best"
+    assert ckpt.exists(), "run test_train_cli_end_to_end first (module fixture)"
+
+    test_mod.main(overrides + [f"checkpoint={ckpt}"])
+    out = capsys.readouterr().out
+    assert "dL_MSE" in out and "dL_MIX" in out
+
+    version_dir = ckpt.parent.parent
+    acc = EventAccumulator(str(version_dir), size_guidance={"images": 0})
+    acc.Reload()
+    image_tags = acc.Tags()["images"]
+    for tag in ("scatter/alphas", "hist/betas", "estimation/alpha"):
+        assert tag in image_tags, f"missing figure {tag}"
+    scalar_tags = acc.Tags()["scalars"]
+    assert "delta/model/mix" in scalar_tags
+    assert "delta/ols/mix" in scalar_tags
+
+
+def test_eval_cli_without_checkpoint_exits_cleanly(cli_run, capsys):
+    root, overrides = cli_run
+    test_mod.main(overrides)  # checkpoint stays null
+    assert "No model checkpoint found" in capsys.readouterr().err
