@@ -119,6 +119,7 @@ class ModelSpec:
     weight_decay: float = 1e-5
     mse_weight: float = 1e2
     kernel_impl: str = "auto"  # LSTM recurrence: pallas | xla | interpret
+    remat: bool = False  # rematerialize recurrences (long-lookback memory)
 
     def build_module(self, compute_dtype=jnp.float32):
         from masters_thesis_tpu.models.lstm import LstmEncoder
@@ -129,6 +130,7 @@ class ModelSpec:
             dropout=self.dropout,
             compute_dtype=compute_dtype,
             kernel_impl=self.kernel_impl,
+            remat=self.remat,
         )
 
     @property

@@ -6,18 +6,44 @@ layout, eval driver, figure/delta-loss output — runs in-process on the
 virtual CPU platform.
 """
 
-import numpy as np
+import importlib.util
+import sys
+from pathlib import Path
+
 import pytest
 from tensorboard.backend.event_processing.event_accumulator import (
     EventAccumulator,
 )
 
-import test as test_mod
-import train as train_mod
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_driver(name: str):
+    """Import a repo-root driver script by file path.
+
+    A plain ``import test``/``import train`` only works when the repo root
+    happens to lead sys.path (and ``test`` collides with CPython's stdlib
+    test package); loading by location is entry-point-independent.
+    """
+    if str(_REPO_ROOT) not in sys.path:
+        # test.py itself does `from train import ...` — the root must be
+        # importable for the drivers' own cross-imports.
+        sys.path.insert(0, str(_REPO_ROOT))
+    spec = importlib.util.spec_from_file_location(
+        f"_driver_{name}", _REPO_ROOT / f"{name}.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+train_mod = _load_driver("train")
+test_mod = _load_driver("test")
 
 
 @pytest.fixture(scope="module")
 def cli_run(tmp_path_factory):
+    """One trained CLI run; each test inspects its artifacts independently."""
     root = tmp_path_factory.mktemp("cli")
     overrides = [
         "trainer=fast",
@@ -32,12 +58,12 @@ def cli_run(tmp_path_factory):
         f"logger.save_dir={root}/logs",
         "logger.version=cli_test",
     ]
+    train_mod.main(overrides)
     return root, overrides
 
 
 def test_train_cli_end_to_end(cli_run):
-    root, overrides = cli_run
-    train_mod.main(overrides)
+    root, _ = cli_run
     version_dir = root / "logs" / "FinancialLstm" / "synthetic" / "cli_test"
     assert (version_dir / "checkpoints" / "best").exists()
     assert (version_dir / "checkpoints" / "last.json").exists()
@@ -48,7 +74,6 @@ def test_eval_cli_renders_figures_and_deltas(cli_run, capsys):
     root, overrides = cli_run
     ckpt = root / "logs" / "FinancialLstm" / "synthetic" / "cli_test"
     ckpt = ckpt / "checkpoints" / "best"
-    assert ckpt.exists(), "run test_train_cli_end_to_end first (module fixture)"
 
     test_mod.main(overrides + [f"checkpoint={ckpt}"])
     out = capsys.readouterr().out

@@ -151,3 +151,25 @@ def test_gradients_flow_through_all_layers():
     for layer in range(2):
         g = np.asarray(grads["params"][f"w_hh_l{layer}"])
         assert np.any(g != 0)
+
+
+def test_remat_gradients_match_plain():
+    """jax.checkpoint over the recurrence must not change gradients — only
+    the backward's memory/recompute schedule (the long-lookback knob)."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(3, 40, 3)).astype(np.float32))
+
+    plain = LstmEncoder(hidden_size=8, num_layers=2, dropout=0.0)
+    remat = LstmEncoder(hidden_size=8, num_layers=2, dropout=0.0, remat=True)
+    params = plain.init(jax.random.key(0), x)
+
+    def loss(module, p):
+        alpha, beta = module.apply(p, x)
+        return jnp.sum(alpha**2) + jnp.sum(beta**2)
+
+    g_plain = jax.grad(lambda p: loss(plain, p))(params)
+    g_remat = jax.grad(lambda p: loss(remat, p))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_remat)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)

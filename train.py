@@ -99,6 +99,7 @@ def build_spec(cfg: Config):
         dropout=cfg.model.dropout,
         learning_rate=cfg.model.learning_rate,
         weight_decay=cfg.model.weight_decay,
+        remat=cfg.model.get("remat", False),
     )
     if "mse_weight" in cfg.loss:
         hparams["mse_weight"] = cfg.loss.mse_weight
