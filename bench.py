@@ -22,10 +22,47 @@ Prints exactly one JSON line.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 from pathlib import Path
 
 BASELINE_STEPS_PER_SEC = 200.0
+DEVICE_PROBE_TIMEOUT_S = 180.0
+
+
+def _ensure_responsive_backend() -> bool:
+    """Fall back to CPU if the TPU relay is wedged; True if degraded.
+
+    A hung relay session blocks ``jax.devices()`` forever (no client-side
+    timeout), which would hang the whole benchmark run. Probe device init in
+    a subprocess with a timeout; on failure, force the CPU backend so the
+    bench still produces a real (if degraded) measurement, flagged by the
+    ``device`` field in the output.
+    """
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=DEVICE_PROBE_TIMEOUT_S,
+            check=True,
+            capture_output=True,
+        )
+        return False
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
+        print(
+            f"device probe failed ({type(exc).__name__}); "
+            "falling back to CPU backend",
+            file=sys.stderr,
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        return True
 
 # Scaled-down sample count (100k vs the reference's 1M bootstrap) keeps the
 # bench wall-clock to a couple of minutes; per-step work is IDENTICAL to the
@@ -36,6 +73,10 @@ MEASURE_EPOCHS = 8
 
 
 def main() -> None:
+    degraded = _ensure_responsive_backend()
+    # CPU fallback is ~300x slower per step: trim the measurement window so
+    # the run still finishes inside a driver timeout.
+    measure_epochs = 2 if degraded else MEASURE_EPOCHS
     from masters_thesis_tpu.data.pipeline import (
         FinancialWindowDataModule,
         bootstrap_synthetic,
@@ -53,7 +94,7 @@ def main() -> None:
 
     spec = ModelSpec(objective="mse")  # model=small defaults, loss=mse
     trainer = Trainer(
-        max_epochs=1 + MEASURE_EPOCHS,  # epoch 0 absorbs compile
+        max_epochs=1 + measure_epochs,  # epoch 0 absorbs compile
         gradient_clip_val=5.0,
         check_val_every_n_epoch=10_000,  # pure train throughput
         strategy="single_device",
@@ -76,7 +117,7 @@ def main() -> None:
                 "detail": {
                     "windows_per_epoch": len(dm.train_range),
                     "batch_size": 1,
-                    "measure_epochs": MEASURE_EPOCHS,
+                    "measure_epochs": measure_epochs,
                     "wall_s": round(wall, 1),
                     "device": str(trainer.mesh.devices.ravel()[0].platform),
                 },
