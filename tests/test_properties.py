@@ -1,0 +1,115 @@
+"""Property-based tests (hypothesis) for the numerical core invariants.
+
+The oracle tests pin exact values; these pin *laws* that must hold for any
+shape/stride/data the pipeline can produce — the class of bugs exact-value
+tests miss (off-by-one window starts, stride/shape interactions, scale
+covariance of the OLS fit).
+"""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from masters_thesis_tpu.ops import (
+    add_quadratic_features,
+    lookback_target_split,
+    ols,
+)
+
+# Keep examples small: every example traces through jnp on CPU.
+SET = settings(max_examples=25, deadline=None)
+
+
+@st.composite
+def window_params(draw):
+    look = draw(st.integers(2, 12))
+    tgt = draw(st.integers(2, 8))
+    stride = draw(st.integers(1, 20))
+    n_extra = draw(st.integers(0, 30))
+    n_samples = look + tgt + n_extra
+    k = draw(st.integers(1, 4))
+    return k, n_samples, look, tgt, stride
+
+
+@given(window_params())
+@SET
+def test_window_split_invariants(params):
+    k, n_samples, look, tgt, stride = params
+    rng = np.random.default_rng(0)
+    stocks = rng.normal(size=(k, n_samples)).astype(np.float32)
+    market = rng.normal(size=(n_samples,)).astype(np.float32)
+
+    x, y = lookback_target_split(stocks, market, look, tgt, stride)
+    n_win = (n_samples - (look + tgt)) // stride + 1
+
+    # Law 1: window count follows the strided-coverage formula.
+    assert x.shape == (n_win, k, look, 2)
+    assert y.shape == (n_win, k, tgt, 2)
+
+    # Law 2: every window is a verbatim strided slice of the source series
+    # and the target follows the lookback with no gap or overlap.
+    for w in (0, n_win - 1):
+        s = w * stride
+        np.testing.assert_array_equal(
+            np.asarray(x[w, :, :, 0]), stocks[:, s : s + look]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(y[w, :, :, 0]), stocks[:, s + look : s + look + tgt]
+        )
+        np.testing.assert_array_equal(np.asarray(x[w, :, :, 1]),
+                                      np.broadcast_to(market[s : s + look], (k, look)))
+
+
+@given(
+    st.integers(3, 40),
+    st.floats(-2, 2),
+    st.floats(-3, 3),
+)
+@SET
+def test_ols_exact_on_noiseless_line(n, alpha, beta):
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(n,)).astype(np.float64)
+    x[0] += 3.0  # guarantee spread
+    y = (alpha + beta * x)[None, :]
+    a_hat, b_hat = ols(x.astype(np.float32), y.astype(np.float32))
+    assert abs(float(a_hat) - alpha) < 5e-3 + 1e-2 * abs(alpha)
+    assert abs(float(b_hat) - beta) < 5e-3 + 1e-2 * abs(beta)
+
+
+@given(st.floats(0.1, 10), st.integers(4, 30))
+@SET
+def test_ols_beta_scale_covariance(scale, n):
+    """Scaling y scales (alpha, beta) linearly; scaling x scales beta by
+    1/s and leaves alpha + beta*mean(x) relationships intact."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(n,)).astype(np.float32)
+    y = rng.normal(size=(2, n)).astype(np.float32)
+    a1, b1 = ols(x, y)
+    a2, b2 = ols(x, np.float32(scale) * y)
+    np.testing.assert_allclose(
+        np.asarray(a2), scale * np.asarray(a1), rtol=2e-3, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(b2), scale * np.asarray(b1), rtol=2e-3, atol=2e-4
+    )
+
+
+@given(st.booleans(), st.booleans())
+@SET
+def test_quadratic_features_composition(interaction_only, include_bias):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 3, 5, 2)).astype(np.float32)
+    out = np.asarray(
+        add_quadratic_features(
+            x, interaction_only=interaction_only, include_bias=include_bias
+        )
+    )
+    expected_features = (3 if interaction_only else 5) + int(include_bias)
+    assert out.shape[-1] == expected_features
+    np.testing.assert_array_equal(out[..., 0], x[..., 0])
+    np.testing.assert_array_equal(out[..., 1], x[..., 1])
+    np.testing.assert_allclose(
+        out[..., 2], x[..., 0] * x[..., 1], rtol=1e-6
+    )
+    if include_bias:
+        np.testing.assert_array_equal(out[..., -1], np.ones_like(out[..., -1]))
