@@ -57,6 +57,7 @@ def bootstrap_synthetic(
     n_samples: int = 1_000_000,
     seed: int = 0,
     variant: str = "no_outliers",
+    marker_grace_s: float = 60.0,
 ) -> None:
     """Generate and save the synthetic market history if not already present.
 
@@ -91,6 +92,11 @@ def bootstrap_synthetic(
     if check_existing():
         return
     if (data_dir / "stocks.npy").exists():
+        # A concurrent writer publishes arrays before the marker: give it a
+        # grace window before declaring the directory torn (parallel sweep
+        # workers sharing a fresh data_dir hit this routinely).
+        if wait_until(check_existing, marker_grace_s):
+            return
         raise ValueError(
             f"{data_dir} contains arrays without a dgp.json sidecar (torn "
             "bootstrap or pre-sidecar dataset of unknown provenance) — "

@@ -94,3 +94,34 @@ def test_eval_cli_without_checkpoint_exits_cleanly(cli_run, capsys):
     root, overrides = cli_run
     test_mod.main(overrides)  # checkpoint stays null
     assert "No model checkpoint found" in capsys.readouterr().err
+
+
+def test_multirun_parallel_launcher(tmp_path, capsys, monkeypatch):
+    """`-m` with launcher.n_jobs=2 runs each sweep point in its own worker
+    process (the reference's joblib launcher semantics,
+    configs/config.yaml:6,17-19)."""
+    # Worker processes have no conftest: strip the ambient TPU-relay plugin
+    # trigger so their inherited JAX_PLATFORMS=cpu actually takes effect
+    # (and two workers never contend for the one relay session).
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    overrides = [
+        "trainer=fast",
+        "trainer.max_epochs=1",
+        "trainer.enable_progress_bar=false",
+        "trainer.enable_model_summary=false",
+        "model.hidden_size=4,8",  # 2 sweep points
+        "model.num_layers=1",
+        "datamodule.n_samples=8000",
+        "datamodule.n_stocks=4",
+        f"datamodule.data_dir={tmp_path}/data",
+        f"logger.save_dir={tmp_path}/logs",
+        "launcher.n_jobs=2",
+    ]
+    train_mod.main(["-m"] + overrides)
+    out = capsys.readouterr().out
+    assert "multirun: 2 jobs, n_jobs=2" in out
+    versions = list((tmp_path / "logs" / "FinancialLstm" / "synthetic").iterdir())
+    assert len(versions) == 2
+    for v in versions:
+        assert (v / "checkpoints" / "best").exists()

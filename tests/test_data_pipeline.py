@@ -176,7 +176,9 @@ def test_bootstrap_refuses_unmarked_arrays(tmp_path):
     sentinel = np.zeros((2, 50), np.float32)
     np.save(tmp_path / "stocks.npy", sentinel)  # torn / pre-sidecar
     with pytest.raises(ValueError, match="sidecar"):
-        bootstrap_synthetic(tmp_path, n_stocks=4, n_samples=500, seed=0)
+        bootstrap_synthetic(
+            tmp_path, n_stocks=4, n_samples=500, seed=0, marker_grace_s=0.1
+        )
     # The unmarked arrays were not touched.
     assert np.load(tmp_path / "stocks.npy").shape == sentinel.shape
 

@@ -34,11 +34,20 @@ from masters_thesis_tpu.config import (
 
 CONFIG_DIR = Path(__file__).resolve().parent / "configs"
 
-# Derived config: feature count from the interaction_only flag
-# (reference: train.py:39-42).
-register_resolver(
-    "input_size_from_interaction", lambda interaction: 3 if interaction else 5
-)
+def _register_resolvers() -> None:
+    """Register the derived-config resolvers (reference: train.py:39-42).
+
+    Called at import AND inside ``_run_job``: a multirun worker process that
+    receives ``_run_job`` by value (cloudpickle) never executes this module's
+    import side effects, so registration must be part of the job itself.
+    """
+    register_resolver(
+        "input_size_from_interaction",
+        lambda interaction: 3 if interaction else 5,
+    )
+
+
+_register_resolvers()
 
 
 def bootstrap(cfg: Config) -> bool:
@@ -190,9 +199,17 @@ def run(cfg: Config) -> float:
     return result.best_val_loss
 
 
-def _run_job(config_dir: str, overrides: list[str]) -> float:
+def _run_job(
+    config_dir: str, overrides: list[str], job_index: int | None = None
+) -> float:
     """Top-level function so the process-pool launcher can pickle it."""
+    _register_resolvers()
     cfg = compose(config_dir, overrides=overrides)
+    if job_index is not None:
+        # Every sweep point gets a unique log/checkpoint dir even when the
+        # swept parameter isn't part of the version interpolation (the
+        # reference gets this from Hydra's numbered per-job sweep dirs).
+        cfg.logger["version"] = f"{cfg.logger.version}_job{job_index}"
     return run(cfg)
 
 
@@ -236,25 +253,29 @@ def main(argv: list[str] | None = None) -> None:
         os.environ.get("MT_HOST_INDEX", cfg0.launcher.get("host_index", 0))
     )
     total = len(jobs)
+    # Jobs keep their GLOBAL sweep index across host partitions so the
+    # _job<N> log/checkpoint suffix is collision-free fleet-wide.
+    indexed = list(enumerate(jobs))
     if num_hosts > 1:
-        jobs = partition_jobs(jobs, host_index, num_hosts)
+        indexed = partition_jobs(indexed, host_index, num_hosts)
         print(
             f"multirun: host {host_index}/{num_hosts} takes "
-            f"{len(jobs)}/{total} jobs"
+            f"{len(indexed)}/{total} jobs"
         )
-    print(f"multirun: {len(jobs)} jobs, n_jobs={n_jobs}")
+    print(f"multirun: {len(indexed)} jobs, n_jobs={n_jobs}")
     if n_jobs == 1:
         # Sequential jobs share this process (and its one TPU client).
-        for i, ov in enumerate(jobs):
+        for i, ov in indexed:
             print(f"--- job {i}: {ov}")
-            _run_job(str(CONFIG_DIR), ov)
+            _run_job(str(CONFIG_DIR), ov, job_index=i)
     else:
         # Process-per-job, like the reference's joblib launcher
         # (reference: configs/config.yaml:6,17-19).
         import joblib
 
         joblib.Parallel(n_jobs=n_jobs, verbose=10)(
-            joblib.delayed(_run_job)(str(CONFIG_DIR), ov) for ov in jobs
+            joblib.delayed(_run_job)(str(CONFIG_DIR), ov, job_index=i)
+            for i, ov in indexed
         )
 
 
