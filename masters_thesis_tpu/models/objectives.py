@@ -88,15 +88,37 @@ def batched_objective(window_fn: WindowObjective):
     psum across devices) and always include a ``"total"`` entry for the
     objective itself. This is the single lifting used by the jitted train
     step (masters_thesis_tpu.train.steps).
+
+    ``weights`` (optional, (B,)) turns the mean into a weighted mean; a
+    zero-weight window contributes nothing to the loss, its gradient, or the
+    metric sums. Used to handle a padded tail batch without recompiling —
+    pad windows must hold FINITE data (real windows repeated), because a
+    NaN loss value survives ``0 * NaN`` in reverse-mode AD.
     """
 
-    def fn(alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array):
+    def fn(
+        alpha: Array,
+        beta: Array,
+        y: Array,
+        factor: Array,
+        inv_psi: Array,
+        weights: Array | None = None,
+    ):
         losses, metrics = jax.vmap(window_fn)(alpha, beta, y, factor, inv_psi)
-        loss = jnp.mean(losses)
-        summed = {
-            k: (jnp.sum(v[0]), jnp.sum(v[1])) for k, v in metrics.items()
-        }
-        summed["total"] = (jnp.sum(losses), jnp.float32(losses.shape[0]))
+        if weights is None:
+            loss = jnp.mean(losses)
+            summed = {
+                k: (jnp.sum(v[0]), jnp.sum(v[1])) for k, v in metrics.items()
+            }
+            summed["total"] = (jnp.sum(losses), jnp.float32(losses.shape[0]))
+        else:
+            wsum = jnp.maximum(jnp.sum(weights), 1.0)
+            loss = jnp.sum(weights * losses) / wsum
+            summed = {
+                k: (jnp.sum(weights * v[0]), jnp.sum(weights * v[1]))
+                for k, v in metrics.items()
+            }
+            summed["total"] = (jnp.sum(weights * losses), wsum)
         return loss, summed
 
     return fn

@@ -3,8 +3,9 @@
 The reference wraps its multivariate-Gaussian NLL in a TorchMetric with
 distributed-reduction state (reference: src/model.py:12-69); here the
 *numerics* live as stateless functions (this module) and the *accumulation /
-cross-device reduction* lives in ``masters_thesis_tpu.train.metrics`` as psum-
-reducible pytrees — the idiomatic JAX split of the same capability.
+cross-device reduction* lives in ``masters_thesis_tpu.train.steps`` as psum-
+reducible (value_sum, weight) pytrees — the idiomatic JAX split of the same
+capability.
 """
 
 from __future__ import annotations

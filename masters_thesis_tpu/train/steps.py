@@ -168,6 +168,7 @@ def make_train_step(
     window_objective: WindowObjective,
     tx,
     mesh: Mesh,
+    weighted: bool = False,
 ) -> Callable:
     """Per-batch jitted update for the ``stream`` epoch mode.
 
@@ -175,12 +176,25 @@ def make_train_step(
     sharded on its window axis (the prefetcher places it), params arrive
     replicated, and XLA's sharding propagation inserts the gradient
     all-reduce — no explicit collectives in user code.
-    """
-    loss_fn = _make_loss_fn(module, window_objective)
 
-    def step_fn(params, opt_state, lr, rng, batch: Batch):
+    With ``weighted=True`` the step takes an extra ``(B,)`` weight vector
+    and optimizes the weighted-mean loss. The trainer uses this to run the
+    epoch's tail partial batch (padded back to the full batch shape with
+    zero-weight windows) through the SAME compiled program — the reference's
+    DataLoader trains on the tail too (drop_last defaults to False), so
+    dropping it would silently change the optimization trajectory.
+    """
+    batched = batched_objective(window_objective)
+
+    def loss_fn(params, step_rng, batch: Batch, weights):
+        alpha, beta = forward_rows(module, params, batch.x, dropout_rng=step_rng)
+        return batched(
+            alpha, beta, batch.y, batch.factor, batch.inv_psi, weights=weights
+        )
+
+    def step_core(params, opt_state, lr, rng, batch: Batch, weights):
         (_, sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, rng, batch
+            params, rng, batch, weights
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(
@@ -191,6 +205,17 @@ def make_train_step(
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P(DATA_AXIS))
     batch_sh = Batch(shard, shard, shard, shard)
+    if weighted:
+        return jax.jit(
+            step_core,
+            donate_argnums=(0, 1),
+            in_shardings=(repl, repl, repl, repl, batch_sh, shard),
+            out_shardings=(repl, repl, repl),
+        )
+
+    def step_fn(params, opt_state, lr, rng, batch: Batch):
+        return step_core(params, opt_state, lr, rng, batch, None)
+
     return jax.jit(
         step_fn,
         donate_argnums=(0, 1),

@@ -241,6 +241,16 @@ class Trainer:
         objective = spec.window_objective()
 
         val_prepared = self._eval_split(dm.val_arrays())
+        if val_prepared is None:
+            # Without a val split there is no plateau signal and no best-val
+            # watermark; warn loudly instead of silently returning inf (a
+            # sweep minimizing best_val would rank such runs last without
+            # explanation) and fall back to best=last below.
+            self._print(
+                "warning: val split is empty — LR plateau scheduling is "
+                "inactive and 'best' falls back to the final checkpoint, "
+                "ranked by final TRAIN loss"
+            )
         eval_fn = make_eval_fn(module, objective, self.mesh)
 
         if self.epoch_mode == "scan":
@@ -259,27 +269,42 @@ class Trainer:
         elif self.epoch_mode == "stream":
             global_b = dm.batch_size * self.n_dev
             n_train = len(dm.train_range)
-            steps_per_epoch = n_train // global_b
-            if steps_per_epoch == 0:
-                raise ValueError(
-                    f"train split has {n_train} windows < one global batch "
-                    f"({dm.batch_size} x {self.n_dev} devices)"
-                )
-            step_fn = make_train_step(module, objective, tx, self.mesh)
+            if n_train == 0:
+                raise ValueError("train split has 0 windows")
+            # The tail partial batch trains too (the reference's DataLoader
+            # drop_last defaults to False): it is padded back to global_b by
+            # cycling its own windows with zero weight, so every epoch runs
+            # ceil(n/global_b) steps through ONE compiled program.
+            steps_per_epoch = -(-n_train // global_b)
+            step_fn = make_train_step(
+                module, objective, tx, self.mesh, weighted=True
+            )
             shard = batch_sharding(self.mesh)
+
+            def weighted_batches(batches):
+                full_w = np.ones((global_b,), np.float32)
+                for b in batches:
+                    n = b.x.shape[0]
+                    if n == global_b:
+                        yield b, full_w
+                    else:
+                        idx = np.arange(global_b) % n
+                        yield (
+                            Batch(*(np.asarray(a)[idx] for a in b)),
+                            (np.arange(global_b) < n).astype(np.float32),
+                        )
 
             def run_epoch(params, opt_state, lr, epoch_rng, epoch):
                 sums = None
                 it = dm._iterate(
                     dm.train_range, global_b, shuffle_seed=(self.seed, epoch)
                 )
-                full = (b for b in it if b.x.shape[0] == global_b)
-                for i, batch in enumerate(
-                    prefetch_to_device(full, sharding=shard)
+                for i, (batch, w) in enumerate(
+                    prefetch_to_device(weighted_batches(it), sharding=shard)
                 ):
                     step_rng = jax.random.fold_in(epoch_rng, i)
                     params, opt_state, step_sums = step_fn(
-                        params, opt_state, lr, step_rng, batch
+                        params, opt_state, lr, step_rng, batch, w
                     )
                     sums = (
                         step_sums
@@ -362,7 +387,9 @@ class Trainer:
                 params, opt_state, lr, epoch_rng, epoch
             )
             total_steps += steps_per_epoch
-            row = {"epoch": epoch, "lr": scheduler.lr}
+            # 'lr-Adam' matches the reference's LearningRateMonitor scalar
+            # tag (reference: train.py:162-165 names it lr-<optimizer>).
+            row = {"epoch": epoch, "lr-Adam": scheduler.lr}
 
             # Previous epoch's readback overlaps this epoch's execution.
             if pending is not None:
@@ -390,7 +417,7 @@ class Trainer:
                         {f"loss/{k}/val": v for k, v in val_metrics.items()}
                     )
                     val_loss = val_metrics["total"]
-                    row["lr"] = scheduler.step(val_loss)
+                    row["lr-Adam"] = scheduler.step(val_loss)
                     if val_loss < best_val:
                         best_val = val_loss
                         self._save("best", params, opt_state, spec, epoch,
@@ -423,6 +450,14 @@ class Trainer:
             if elapsed > 0 and post_compile_steps > 0
             else 0.0
         )
+
+        # Empty-val fallback: rank the run by its final train loss and make
+        # 'best' exist (pointing at the final params) so downstream tooling
+        # (test.py, warmup) keeps working.
+        if val_prepared is None and not diverged and history:
+            best_val = history[-1].get("loss/total/train", best_val)
+            self._save("best", params, opt_state, spec, self.max_epochs - 1,
+                       best_val, dm, scheduler, best_val)
 
         # 'last' must hold the FINAL params even when the last epoch wasn't a
         # val epoch (Lightning's save_last=True, train.py:159) — but a

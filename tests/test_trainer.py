@@ -95,6 +95,13 @@ class TestFit:
             row["loss/total/val"] for row in result.history
         ) + 1e-12
 
+    def test_lr_logged_under_reference_tag(self, tiny_dm):
+        """LR is logged as 'lr-Adam', the tag the reference's
+        LearningRateMonitor emits (reference: train.py:162-165)."""
+        result = make_trainer(max_epochs=1).fit(small_spec(), tiny_dm)
+        assert "lr-Adam" in result.history[0]
+        assert "lr" not in result.history[0]
+
     def test_stream_mode_matches_scan_mode(self, tiny_dm):
         """Same seed, same data: the pjit stream path and the shard_map scan
         path must optimize comparably (not bitwise — shuffle orders differ —
@@ -228,6 +235,114 @@ class TestCheckpoint:
         params, _, spec, _ = restore_checkpoint(ckpt_dir, "last")
         restored = trainer.test(spec, params, tiny_dm)
         assert restored["mae"] == pytest.approx(live["mae"], rel=1e-5)
+
+
+class TestStreamTail:
+    def test_padded_tail_step_matches_unpadded(self, tiny_dm):
+        """A tail batch padded to the full batch shape by cycling its own
+        windows with zero weight must produce the SAME parameter update and
+        metric sums as stepping on the bare tail — the mechanism stream mode
+        uses to train the epoch's partial batch without a recompile
+        (the reference's DataLoader trains the tail too: drop_last=False)."""
+        import jax.numpy as jnp
+
+        from masters_thesis_tpu.data.pipeline import Batch
+        from masters_thesis_tpu.parallel import make_data_mesh
+        from masters_thesis_tpu.train.optim import make_optimizer
+        from masters_thesis_tpu.train.steps import make_train_step
+
+        spec = small_spec()
+        module = spec.build_module()
+        mesh = make_data_mesh(1)
+        tx = make_optimizer(5.0, spec.weight_decay)
+        tail = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[:3], tiny_dm.train_arrays()
+        )
+        rng = jax.random.key(0)
+        dummy = jnp.zeros(
+            (1, tiny_dm.lookback_window, tiny_dm.n_features), jnp.float32
+        )
+        step = make_train_step(
+            module, spec.window_objective(), tx, mesh, weighted=True
+        )
+        lr = jnp.float32(1e-2)
+
+        def run(batch, weights):
+            params = module.init(rng, dummy)["params"]  # donated per call
+            opt_state = tx.init(params)
+            return step(params, opt_state, lr, rng, batch, weights)
+
+        p_tail, _, s_tail = run(tail, np.ones((3,), np.float32))
+        idx = np.arange(4) % 3
+        padded = Batch(*(np.asarray(a)[idx] for a in tail))
+        p_pad, _, s_pad = run(padded, (np.arange(4) < 3).astype(np.float32))
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_tail), jax.tree_util.tree_leaves(p_pad)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            )
+        for key in s_tail:
+            np.testing.assert_allclose(
+                np.asarray(s_tail[key]), np.asarray(s_pad[key]), rtol=1e-6
+            )
+
+    def test_stream_epoch_with_tail_trains(self, tiny_dm):
+        """Stream mode on a split whose size is NOT a multiple of the global
+        batch must still run and converge (the tail is trained, not
+        dropped)."""
+        n_train = len(tiny_dm.train_range)
+        assert n_train % 2 == 0  # fixture uses batch_size=2; force a tail
+        tiny_dm.batch_size = 3
+        try:
+            assert n_train % 3 != 0
+            result = make_trainer(
+                strategy="single_device", epoch_mode="stream", max_epochs=2
+            ).fit(small_spec(), tiny_dm)
+        finally:
+            tiny_dm.batch_size = 2
+        assert np.isfinite(result.history[-1]["loss/total/train"])
+        assert (
+            result.history[-1]["loss/total/train"]
+            < result.history[0]["loss/total/train"]
+        )
+
+
+class TestEmptyValSplit:
+    def test_best_falls_back_to_last(self, tmp_path):
+        """With zero val windows, fit must still publish a 'best' checkpoint
+        (the final params) and return a finite best_val (the final TRAIN
+        loss) instead of inf — a sweep minimizing best_val would otherwise
+        silently rank such runs last."""
+        r_stocks, r_market, _, _ = SyntheticLogReturns.generate(
+            n_stocks=4, n_samples=48, seed=3
+        )
+        np.save(tmp_path / "stocks.npy", np.asarray(r_stocks))
+        np.save(tmp_path / "market.npy", np.asarray(r_market))
+        # 48 samples / (16+8 window, stride 24) -> 2 windows: train=1,
+        # val=range(1,1) empty, test=1.
+        dm = FinancialWindowDataModule(
+            tmp_path, lookback_window=16, target_window=8, stride=24,
+            batch_size=1,
+        )
+        dm.prepare_data(verbose=False)
+        dm.setup()
+        assert len(dm.val_range) == 0
+        ckpt_dir = tmp_path / "ckpts"
+        result = make_trainer(
+            strategy="single_device", max_epochs=2, ckpt_dir=ckpt_dir
+        ).fit(small_spec(), dm)
+        assert np.isfinite(result.best_val_loss)
+        assert result.best_val_loss == pytest.approx(
+            result.history[-1]["loss/total/train"]
+        )
+        params, _, _, _ = restore_checkpoint(ckpt_dir, "best")
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(jax.device_get(result.params)),
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
 
 
 class TestPlateauScheduler:
