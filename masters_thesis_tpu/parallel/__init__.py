@@ -14,6 +14,7 @@ from masters_thesis_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     distributed_initialize,
+    global_put,
     make_data_mesh,
     replicated_sharding,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "DATA_AXIS",
     "batch_sharding",
     "distributed_initialize",
+    "global_put",
     "make_data_mesh",
     "replicated_sharding",
 ]

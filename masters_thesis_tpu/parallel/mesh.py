@@ -25,6 +25,7 @@ def distributed_initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    required: bool = False,
 ) -> None:
     """Initialize multi-host JAX (no-op for single-process runs).
 
@@ -32,9 +33,17 @@ def distributed_initialize(
     perform under DDP (latent in the reference; SURVEY.md §2.2). With no
     arguments, reads the standard cluster env (TPU pod metadata / SLURM /
     ``JAX_COORDINATOR_ADDRESS``).
+
+    ``required=True`` (set when the user explicitly asked for distributed
+    training, e.g. ``trainer.distributed=true``) turns an init failure into
+    an error — silently degrading a misconfigured pod to single-host
+    training would burn a full training run before anyone noticed.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NOT jax.process_count(): that would itself initialize the XLA backend,
+    # after which jax.distributed.initialize() refuses to run — the guard
+    # must be side-effect-free.
+    if jax.distributed.is_initialized():
+        return
     try:
         if coordinator_address is None and num_processes is None:
             jax.distributed.initialize()
@@ -44,9 +53,39 @@ def distributed_initialize(
                 num_processes=num_processes,
                 process_id=process_id,
             )
-    except (ValueError, RuntimeError):
+    except (ValueError, RuntimeError) as exc:
+        if required:
+            raise RuntimeError(
+                "distributed initialization was explicitly requested but "
+                f"failed ({exc}); check the coordinator address / cluster "
+                "env (JAX_COORDINATOR_ADDRESS, process count, process id)"
+            ) from exc
         # Single-process environment without coordinator metadata.
-        pass
+
+
+def global_put(tree, sharding: NamedSharding):
+    """Place a host pytree onto a (possibly multi-process) sharding.
+
+    Single-process meshes take the fast ``jax.device_put`` path. When the
+    mesh spans processes, ``device_put`` would reject the non-addressable
+    shards; instead each process materializes the shards its own devices
+    hold via ``make_array_from_callback``. Every process passes the SAME
+    full host value (the datamodule cache is shared/deterministic per
+    host — SURVEY.md §7 multi-host data), and the callback slices out the
+    local blocks.
+    """
+
+    def put(a):
+        if sharding.is_fully_addressable:
+            # Fast path: device-side resharding; no host round-trip for
+            # already-device-resident leaves (params/opt_state after init).
+            return jax.device_put(a, sharding)
+        a = np.asarray(a)
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx]
+        )
+
+    return jax.tree_util.tree_map(put, tree)
 
 
 def make_data_mesh(n_devices: int | None = None) -> Mesh:

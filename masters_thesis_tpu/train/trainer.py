@@ -36,6 +36,7 @@ from masters_thesis_tpu.models.objectives import ModelSpec
 from masters_thesis_tpu.parallel import (
     DATA_AXIS,
     batch_sharding,
+    global_put,
     make_data_mesh,
 )
 from masters_thesis_tpu.train import checkpoint as ckpt_lib
@@ -128,7 +129,7 @@ class Trainer:
             lambda a: a[: n_local * self.n_dev], arrays
         )
         return (
-            jax.device_put(trunc, batch_sharding(self.mesh)),
+            global_put(trunc, batch_sharding(self.mesh)),
             n_local,
         )
 
@@ -152,10 +153,10 @@ class Trainer:
         from jax.sharding import NamedSharding, PartitionSpec
 
         sharding = NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
-        batch = jax.device_put(
+        batch = global_put(
             jax.tree_util.tree_map(pad_reshape, arrays), sharding
         )
-        return batch, jax.device_put(mask, sharding)
+        return batch, global_put(mask, sharding)
 
     # ----------------------------------------------------------------- fit
 
@@ -236,8 +237,8 @@ class Trainer:
         # Commit to the mesh BEFORE the first epoch: epoch outputs carry
         # mesh-tagged avals, and untagged first-call inputs would otherwise
         # trace+compile the epoch program a second time at epoch 1.
-        params = jax.device_put(params, repl)
-        opt_state = jax.device_put(opt_state, repl)
+        params = global_put(params, repl)
+        opt_state = global_put(opt_state, repl)
         objective = spec.window_objective()
 
         val_prepared = self._eval_split(dm.val_arrays())

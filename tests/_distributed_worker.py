@@ -1,0 +1,93 @@
+"""Worker process for the REAL 2-process distributed integration test.
+
+Not a pytest module (no ``test_`` prefix): tests/test_distributed.py spawns
+two of these with a shared coordinator address, one CPU device each —
+exercising ``jax.distributed.initialize``, the multi-host bootstrap/cache
+rendezvous, and the cross-process shard_map train/eval path for real
+(everything the reference's latent DDP story would do over NCCL,
+reference: train.py:169-180, src/model.py:24-25).
+
+Usage: python tests/_distributed_worker.py <coord_addr> <rank> <world> <workdir>
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def main() -> None:
+    coord, rank, world, workdir = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        Path(sys.argv[4]),
+    )
+    from masters_thesis_tpu.parallel import distributed_initialize
+
+    distributed_initialize(
+        coordinator_address=coord,
+        num_processes=world,
+        process_id=rank,
+        required=True,
+    )
+    import jax
+
+    assert jax.process_count() == world, jax.process_count()
+    assert len(jax.devices()) == world  # one CPU device per process
+
+    import numpy as np
+
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.train import Trainer
+
+    # Every rank calls bootstrap against the SHARED dir: rank 0 generates,
+    # the others block on the dgp.json completion marker (the rendezvous
+    # that was previously only ever monkeypatch-simulated).
+    data_dir = workdir / "data"
+    bootstrap_synthetic(data_dir, n_stocks=4, n_samples=4000, seed=0)
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24, batch_size=1
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+
+    trainer = Trainer(
+        max_epochs=2,
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=1,
+        strategy="tpu_xla",
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+    assert trainer.n_dev == world
+    spec = ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        learning_rate=1e-2,
+    )
+    result = trainer.fit(spec, dm)
+    test_metrics = trainer.test(spec, result.params, dm)
+
+    leaves = jax.tree_util.tree_leaves(jax.device_get(result.params))
+    np.savez(workdir / f"rank{rank}.npz", *[np.asarray(l) for l in leaves])
+    (workdir / f"rank{rank}.json").write_text(
+        json.dumps(
+            {
+                "history": result.history,
+                "best_val": result.best_val_loss,
+                "test": test_metrics,
+                "process_count": jax.process_count(),
+                "n_dev": trainer.n_dev,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Real multi-process distributed integration test.
+
+Unlike every other test (which runs on the in-process 8-device virtual
+mesh), this spawns TWO actual OS processes that rendezvous through
+``jax.distributed.initialize`` with one CPU device each — the same
+machinery a multi-host TPU pod uses, minus the hardware. It proves:
+
+- the coordinator handshake works (``distributed_initialize`` with explicit
+  coordinator/rank args, ``required=True``),
+- the bootstrap + dataset-cache rendezvous works across processes
+  (rank 0 writes, rank 1 blocks on the completion marker),
+- the scan-epoch shard_map program runs over a mesh whose devices live in
+  DIFFERENT processes (``global_put`` materializing per-process shards),
+- both ranks converge to IDENTICAL final params and loss history — the
+  DDP invariant, for real this time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_WORKER = Path(__file__).resolve().parent / "_distributed_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_training(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = os.environ.copy()
+    # Hermetic from the TPU relay (see conftest.py) and exactly ONE CPU
+    # device per process so the 2-process world is a 2-device mesh.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = str(_REPO_ROOT)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), coord, str(rank), "2", str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+
+    meta = [
+        json.loads((tmp_path / f"rank{r}.json").read_text()) for r in (0, 1)
+    ]
+    for m in meta:
+        assert m["process_count"] == 2
+        assert m["n_dev"] == 2
+        assert np.isfinite(m["best_val"])
+        assert np.isfinite(m["test"]["mae"])
+    # Same program, same psum'd grads => identical history on every rank.
+    assert meta[0]["history"] == meta[1]["history"]
+    assert meta[0]["history"]  # non-empty
+
+    a = np.load(tmp_path / "rank0.npz")
+    b = np.load(tmp_path / "rank1.npz")
+    assert a.files
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])

@@ -131,7 +131,9 @@ def run(cfg: Config) -> float:
     if cfg.trainer.get("distributed", False):
         from masters_thesis_tpu.parallel import distributed_initialize
 
-        distributed_initialize()
+        # required=True: the user asked for distributed — a misconfigured
+        # coordinator must fail loudly, not degrade to single-host.
+        distributed_initialize(required=True)
 
     if not bootstrap(cfg):
         return float("inf")
@@ -199,17 +201,52 @@ def run(cfg: Config) -> float:
     return result.best_val_loss
 
 
+def _plain(obj):
+    """Config -> plain dict/list tree (yaml.safe_dump rejects subclasses)."""
+    if isinstance(obj, dict):
+        return {k: _plain(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_plain(v) for v in obj]
+    return obj
+
+
+def _write_job_metadata(job_dir: Path, cfg: Config, overrides: list[str]):
+    """Hydra-compatible per-job metadata: .hydra/config.yaml + overrides.yaml
+    (what a Hydra user expects to find inside multirun/<date>/<time>/<n>/)."""
+    import yaml
+
+    meta_dir = job_dir / ".hydra"
+    meta_dir.mkdir(parents=True, exist_ok=True)
+    (meta_dir / "config.yaml").write_text(
+        yaml.safe_dump(_plain(cfg), sort_keys=False)
+    )
+    (meta_dir / "overrides.yaml").write_text(yaml.safe_dump(list(overrides)))
+
+
 def _run_job(
-    config_dir: str, overrides: list[str], job_index: int | None = None
+    config_dir: str,
+    overrides: list[str],
+    job_index: int | None = None,
+    sweep_dir: str | None = None,
 ) -> float:
     """Top-level function so the process-pool launcher can pickle it."""
     _register_resolvers()
     cfg = compose(config_dir, overrides=overrides)
     if job_index is not None:
-        # Every sweep point gets a unique log/checkpoint dir even when the
-        # swept parameter isn't part of the version interpolation (the
-        # reference gets this from Hydra's numbered per-job sweep dirs).
-        cfg.logger["version"] = f"{cfg.logger.version}_job{job_index}"
+        if sweep_dir is not None and not Path(cfg.logger.save_dir).is_absolute():
+            # Hydra multirun layout: each sweep point owns a numbered job
+            # dir <sweep_dir>/<job_idx>/ holding its logs, checkpoints, and
+            # .hydra metadata (the reference gets this from Hydra's
+            # numbered per-job sweep dirs, configs/config.yaml:6,17-19).
+            job_dir = Path(sweep_dir) / str(job_index)
+            cfg.logger["save_dir"] = str(job_dir / cfg.logger.save_dir)
+            _write_job_metadata(job_dir, cfg, overrides)
+        else:
+            # An absolute save_dir pins the output location (Hydra's logger
+            # would do the same); fall back to a version suffix so every
+            # sweep point still gets a unique log/checkpoint dir even when
+            # the swept parameter isn't part of the version interpolation.
+            cfg.logger["version"] = f"{cfg.logger.version}_job{job_index}"
     return run(cfg)
 
 
@@ -245,6 +282,7 @@ def main(argv: list[str] | None = None) -> None:
 
     jobs = expand_multirun(args.overrides)
     cfg0 = compose(str(CONFIG_DIR), overrides=jobs[0])
+    launcher_name = cfg0.launcher.get("name", "sequential")
     n_jobs = int(cfg0.launcher.get("n_jobs", 1))
     num_hosts = int(
         os.environ.get("MT_NUM_HOSTS", cfg0.launcher.get("num_hosts", 1))
@@ -252,9 +290,17 @@ def main(argv: list[str] | None = None) -> None:
     host_index = int(
         os.environ.get("MT_HOST_INDEX", cfg0.launcher.get("host_index", 0))
     )
+    # Numbered sweep output root (Hydra's multirun/<date>/<time>); pin it
+    # via launcher.sweep_dir or MT_SWEEP_DIR when sharding across hosts.
+    sweep_dir = os.environ.get("MT_SWEEP_DIR") or cfg0.launcher.get("sweep_dir")
+    if not sweep_dir:
+        import datetime
+
+        now = datetime.datetime.now()
+        sweep_dir = f"multirun/{now:%Y-%m-%d}/{now:%H-%M-%S}"
     total = len(jobs)
     # Jobs keep their GLOBAL sweep index across host partitions so the
-    # _job<N> log/checkpoint suffix is collision-free fleet-wide.
+    # numbered job dir (or _job<N> suffix) is collision-free fleet-wide.
     indexed = list(enumerate(jobs))
     if num_hosts > 1:
         indexed = partition_jobs(indexed, host_index, num_hosts)
@@ -263,18 +309,21 @@ def main(argv: list[str] | None = None) -> None:
             f"{len(indexed)}/{total} jobs"
         )
     print(f"multirun: {len(indexed)} jobs, n_jobs={n_jobs}")
-    if n_jobs == 1:
-        # Sequential jobs share this process (and its one TPU client).
+    if n_jobs == 1 and launcher_name != "joblib":
+        # launcher=sequential: jobs share this process (and its one TPU
+        # client + warm compile cache).
         for i, ov in indexed:
             print(f"--- job {i}: {ov}")
-            _run_job(str(CONFIG_DIR), ov, job_index=i)
+            _run_job(str(CONFIG_DIR), ov, job_index=i, sweep_dir=sweep_dir)
     else:
-        # Process-per-job, like the reference's joblib launcher
-        # (reference: configs/config.yaml:6,17-19).
+        # launcher=joblib (or n_jobs>1): process-per-job, like the
+        # reference's joblib launcher (reference: configs/config.yaml:6,17-19).
         import joblib
 
         joblib.Parallel(n_jobs=n_jobs, verbose=10)(
-            joblib.delayed(_run_job)(str(CONFIG_DIR), ov, job_index=i)
+            joblib.delayed(_run_job)(
+                str(CONFIG_DIR), ov, job_index=i, sweep_dir=sweep_dir
+            )
             for i, ov in indexed
         )
 
