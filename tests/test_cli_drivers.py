@@ -96,6 +96,41 @@ def test_eval_cli_without_checkpoint_exits_cleanly(cli_run, capsys):
     assert "No model checkpoint found" in capsys.readouterr().err
 
 
+def test_multirun_numbered_job_dirs(tmp_path, capsys, monkeypatch):
+    """With a relative logger.save_dir, every sweep point writes into a
+    numbered Hydra-style job dir <sweep_dir>/<job_idx>/ carrying .hydra
+    metadata (config.yaml + overrides.yaml), logs, and checkpoints —
+    the layout a Hydra user expects from `python train.py -m ...`
+    (reference: configs/config.yaml:6,17-19)."""
+    monkeypatch.chdir(tmp_path)
+    overrides = [
+        "trainer=fast",
+        "trainer.max_epochs=1",
+        "trainer.enable_progress_bar=false",
+        "trainer.enable_model_summary=false",
+        "model.hidden_size=4,8",  # 2 sweep points
+        "model.num_layers=1",
+        "datamodule.n_samples=8000",
+        "datamodule.n_stocks=4",
+        f"datamodule.data_dir={tmp_path}/data",
+        "logger.save_dir=logs",
+        "launcher.sweep_dir=sweep",
+    ]
+    train_mod.main(["-m"] + overrides)
+    for i, hidden in enumerate((4, 8)):
+        job = tmp_path / "sweep" / str(i)
+        assert (job / ".hydra" / "overrides.yaml").exists()
+        import yaml
+
+        cfg = yaml.safe_load((job / ".hydra" / "config.yaml").read_text())
+        assert cfg["model"]["hidden_size"] == hidden
+        versions = list(
+            (job / "logs" / "FinancialLstm" / "synthetic").iterdir()
+        )
+        assert len(versions) == 1
+        assert (versions[0] / "checkpoints" / "best").exists()
+
+
 def test_multirun_parallel_launcher(tmp_path, capsys, monkeypatch):
     """`-m` with launcher.n_jobs=2 runs each sweep point in its own worker
     process (the reference's joblib launcher semantics,
