@@ -9,6 +9,20 @@ loss=mse (reference: configs/datamodule/synthetic.yaml, configs/model/
 small.yaml) — run through the device-resident scan-epoch trainer on ONE
 chip.
 
+The single JSON line also carries (in "detail"):
+
+- ``nll``: the same measurement for loss=nll — the fused O(K·n)
+  single-factor NLL (ops/losses.py) replacing the reference's dense
+  O(K³) path (reference: src/model.py:44-69, src/common.py:50-78).
+- ``batch_sweep``: windows/sec at batch_size 1/8/32 — where throughput
+  saturates once the per-step dispatch floor is amortized (the tiny-batch
+  regime is the known TPU hard part, SURVEY.md §7).
+- ``scaling``: 1-device vs 8-device scan-epoch throughput at FIXED global
+  batch on the virtual CPU mesh (run in a subprocess so the backend choice
+  doesn't leak into this process) — the strong-scaling methodology artifact
+  for the 1→8→32-chip north star; on virtual devices it measures program
+  structure (collective overhead, per-device dispatch), not real ICI.
+
 vs_baseline: the reference publishes no throughput numbers (SURVEY.md §6).
 The denominator used here is 200 steps/sec/chip — a deliberately generous
 ceiling estimate for the reference's per-step Python dispatch pipeline
@@ -16,7 +30,7 @@ ceiling estimate for the reference's per-step Python dispatch pipeline
 costs >= ~5 ms/step at batch_size=1 regardless of GPU speed). Any value >1
 means this framework beats that ceiling.
 
-Prints exactly one JSON line.
+Prints exactly one JSON line on stdout.
 """
 
 from __future__ import annotations
@@ -29,40 +43,15 @@ import time
 from pathlib import Path
 
 BASELINE_STEPS_PER_SEC = 200.0
-DEVICE_PROBE_TIMEOUT_S = 180.0
 
-
-def _ensure_responsive_backend() -> bool:
-    """Fall back to CPU if the TPU relay is wedged; True if degraded.
-
-    A hung relay session blocks ``jax.devices()`` forever (no client-side
-    timeout), which would hang the whole benchmark run. Probe device init in
-    a subprocess with a timeout; on failure, force the CPU backend so the
-    bench still produces a real (if degraded) measurement, flagged by the
-    ``device`` field in the output.
-    """
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=DEVICE_PROBE_TIMEOUT_S,
-            check=True,
-            capture_output=True,
-        )
-        return False
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
-        print(
-            f"device probe failed ({type(exc).__name__}); "
-            "falling back to CPU backend",
-            file=sys.stderr,
-        )
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-        return True
+# A hung relay session blocks ``jax.devices()`` forever (no client-side
+# timeout). Probe device init in a subprocess under a timeout, and RETRY
+# across a ~10-minute budget — a wedged lease often clears within minutes,
+# and a single failed probe permanently degrading the round's perf evidence
+# to a CPU number is worse than waiting out a flake.
+PROBE_TIMEOUT_S = 120.0
+PROBE_BUDGET_S = 600.0
+PROBE_BACKOFF_S = 15.0
 
 # Scaled-down sample count (100k vs the reference's 1M bootstrap) keeps the
 # bench wall-clock to a couple of minutes; per-step work is IDENTICAL to the
@@ -72,8 +61,162 @@ N_SAMPLES = 100_000
 MEASURE_EPOCHS = 8
 
 
+def _ensure_responsive_backend() -> tuple[bool, int]:
+    """Probe TPU init with retries; returns (degraded_to_cpu, attempts)."""
+    deadline = time.monotonic() + PROBE_BUDGET_S
+    attempts = 0
+    while True:
+        attempts += 1
+        remaining = deadline - time.monotonic()
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=max(10.0, min(PROBE_TIMEOUT_S, remaining)),
+                check=True,
+                capture_output=True,
+            )
+            return False, attempts
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
+            print(
+                f"device probe attempt {attempts} failed "
+                f"({type(exc).__name__}); {max(0.0, remaining):.0f}s budget left",
+                file=sys.stderr,
+            )
+            if isinstance(exc, subprocess.CalledProcessError):
+                # An instant non-zero exit is a deterministic init crash
+                # (broken libtpu, bad platform pin), not a wedged lease —
+                # retrying for 10 minutes would reproduce the same crash;
+                # degrade now. Only timeouts are worth waiting out.
+                stderr = (exc.stderr or b"").decode(errors="replace")
+                print(stderr[-500:], file=sys.stderr)
+                break
+            if time.monotonic() + PROBE_BACKOFF_S >= deadline:
+                break
+            time.sleep(PROBE_BACKOFF_S)
+    print(
+        f"device probe failed {attempts}x over {PROBE_BUDGET_S:.0f}s; "
+        "falling back to CPU backend",
+        file=sys.stderr,
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return True, attempts
+
+
+def _make_trainer(
+    measure_epochs: int,
+    strategy: str = "single_device",
+    n_devices: int | None = None,
+):
+    from masters_thesis_tpu.train import Trainer
+
+    return Trainer(
+        max_epochs=1 + measure_epochs,  # epoch 0 absorbs compile
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=10_000,  # pure train throughput
+        strategy=strategy,
+        n_devices=n_devices,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+
+
+def _measure(dm, objective: str, measure_epochs: int) -> float:
+    """steps/sec for one (datamodule, objective) point; compile excluded."""
+    from masters_thesis_tpu.models.objectives import ModelSpec
+
+    spec = ModelSpec(objective=objective)  # model=small defaults
+    result = _make_trainer(measure_epochs).fit(spec, dm)
+    return result.steps_per_sec
+
+
+def _scaling_child() -> None:
+    """1-dev vs 8-dev scan-epoch throughput at fixed global batch (CPU mesh).
+
+    Runs in a subprocess with JAX_PLATFORMS=cpu +
+    --xla_force_host_platform_device_count=8 set by the parent BEFORE jax
+    imports. Prints one JSON object on stdout.
+    """
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+
+    data_dir = Path(__file__).resolve().parent / "data" / "bench_scaling"
+    bootstrap_synthetic(data_dir, n_stocks=25, n_samples=50_000, seed=0)
+
+    def run(n_devices: int, batch_size: int) -> float:
+        dm = FinancialWindowDataModule(
+            data_dir, lookback_window=60, target_window=30, stride=90,
+            batch_size=batch_size,
+        )
+        dm.prepare_data(verbose=False)
+        dm.setup()
+        from masters_thesis_tpu.models.objectives import ModelSpec
+
+        trainer = _make_trainer(
+            6,
+            strategy="single_device" if n_devices == 1 else "tpu_xla",
+            n_devices=n_devices,
+        )
+        result = trainer.fit(ModelSpec(objective="mse"), dm)
+        return result.steps_per_sec
+
+    global_batch = 8
+    sps_1 = run(1, global_batch)  # 1 device x 8 windows/step
+    sps_8 = run(8, 1)  # 8 devices x 1 window/step, pmean over the mesh
+    speedup = sps_8 / sps_1 if sps_1 > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "global_batch": global_batch,
+                "steps_per_sec_1dev": round(sps_1, 2),
+                "steps_per_sec_8dev": round(sps_8, 2),
+                "speedup_8dev": round(speedup, 3),
+                "efficiency": round(speedup / 8.0, 3),
+            }
+        )
+    )
+
+
+def _run_scaling_subprocess() -> dict | None:
+    env = dict(os.environ)
+    # The TPU-relay plugin trigger would override JAX_PLATFORMS=cpu in the
+    # child (and contend for the one relay session); strip it.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    try:
+        out = subprocess.run(
+            [sys.executable, __file__, "--scaling-child"],
+            env=env,
+            timeout=900,
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # never let the scaling probe kill the bench
+        print(f"scaling subprocess failed: {exc!r}", file=sys.stderr)
+        # CalledProcessError's repr omits the child's output — surface it,
+        # or the failure is undiagnosable after the fact.
+        for stream in ("stdout", "stderr"):
+            text = getattr(exc, stream, None)
+            if text:
+                print(f"child {stream} tail: {text[-500:]}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
-    degraded = _ensure_responsive_backend()
+    degraded, probe_attempts = _ensure_responsive_backend()
     # CPU fallback is ~300x slower per step: trim the measurement window so
     # the run still finishes inside a driver timeout.
     measure_epochs = 2 if degraded else MEASURE_EPOCHS
@@ -81,32 +224,42 @@ def main() -> None:
         FinancialWindowDataModule,
         bootstrap_synthetic,
     )
-    from masters_thesis_tpu.models.objectives import ModelSpec
-    from masters_thesis_tpu.train import Trainer
 
     data_dir = Path(__file__).resolve().parent / "data" / "bench_synthetic"
     bootstrap_synthetic(data_dir, n_stocks=N_STOCKS, n_samples=N_SAMPLES, seed=0)
-    dm = FinancialWindowDataModule(
-        data_dir, lookback_window=60, target_window=30, stride=90, batch_size=1
-    )
-    dm.prepare_data(verbose=False)
-    dm.setup()
 
-    spec = ModelSpec(objective="mse")  # model=small defaults, loss=mse
-    trainer = Trainer(
-        max_epochs=1 + measure_epochs,  # epoch 0 absorbs compile
-        gradient_clip_val=5.0,
-        check_val_every_n_epoch=10_000,  # pure train throughput
-        strategy="single_device",
-        enable_progress_bar=False,
-        enable_model_summary=False,
-        seed=0,
-    )
+    def make_dm(batch_size: int) -> FinancialWindowDataModule:
+        dm = FinancialWindowDataModule(
+            data_dir, lookback_window=60, target_window=30, stride=90,
+            batch_size=batch_size,
+        )
+        dm.prepare_data(verbose=False)
+        dm.setup()
+        return dm
+
     t0 = time.perf_counter()
-    result = trainer.fit(spec, dm)
+    dm1 = make_dm(1)
+    value = _measure(dm1, "mse", measure_epochs)
+
+    # Degraded (wedged relay, CPU fallback): the probe already burned its
+    # 600s budget — measure ONLY the headline point so the one JSON line is
+    # guaranteed to print inside the driver timeout; the auxiliary sections
+    # go null rather than risking no measurement at all.
+    nll_sps = None
+    batch_sweep = {"1": round(value, 2)}
+    scaling = None
+    if not degraded:
+        nll_sps = _measure(dm1, "nll", max(2, measure_epochs // 2))
+        # Batch sweep: amortizing the per-step dispatch floor. windows/sec
+        # = steps/sec * batch_size, comparable across points.
+        for bs in (8, 32):
+            sps = _measure(make_dm(bs), "mse", max(2, measure_epochs // 2))
+            batch_sweep[str(bs)] = round(sps * bs, 2)
+        scaling = _run_scaling_subprocess()
     wall = time.perf_counter() - t0
 
-    value = result.steps_per_sec
+    import jax
+
     print(
         json.dumps(
             {
@@ -115,11 +268,17 @@ def main() -> None:
                 "unit": "steps/s",
                 "vs_baseline": round(value / BASELINE_STEPS_PER_SEC, 3),
                 "detail": {
-                    "windows_per_epoch": len(dm.train_range),
+                    "windows_per_epoch": len(dm1.train_range),
                     "batch_size": 1,
                     "measure_epochs": measure_epochs,
                     "wall_s": round(wall, 1),
-                    "device": str(trainer.mesh.devices.ravel()[0].platform),
+                    "device": jax.devices()[0].platform,
+                    "probe_attempts": probe_attempts,
+                    "nll_steps_per_sec": (
+                        None if nll_sps is None else round(nll_sps, 2)
+                    ),
+                    "batch_sweep_windows_per_sec": batch_sweep,
+                    "scaling_fixed_global_batch": scaling,
                 },
             }
         )
@@ -127,4 +286,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--scaling-child" in sys.argv:
+        _scaling_child()
+    else:
+        main()
