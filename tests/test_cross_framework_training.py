@@ -28,40 +28,12 @@ LR, WD, CLIP = 1e-2, 1e-5, 5.0
 N_STEPS = 20
 
 
-class TorchReferenceModel(torch.nn.Module):
-    """The reference encoder + MSE decoder shape (reference:
-    src/model.py:88-109,192-202), minimal torch re-statement."""
-
-    def __init__(self):
-        super().__init__()
-        self.lstm = torch.nn.LSTM(3, HIDDEN, 1, batch_first=True)
-        self.alpha = torch.nn.Linear(HIDDEN, 1)
-        self.beta = torch.nn.Linear(HIDDEN, 1)
-
-    def forward(self, x):
-        out, _ = self.lstm(x)
-        final = out[:, -1, :]
-        return self.alpha(final), self.beta(final)
-
-
-def flax_params_from_torch(model: TorchReferenceModel):
-    # jnp.array (copy), NOT jnp.asarray: .numpy() shares the torch tensor's
-    # buffer, and torch's in-place opt.step() would mutate an aliased view.
-    params = {
-        "w_ih_l0": jnp.array(model.lstm.weight_ih_l0.detach().numpy()),
-        "w_hh_l0": jnp.array(model.lstm.weight_hh_l0.detach().numpy()),
-        "b_ih_l0": jnp.array(model.lstm.bias_ih_l0.detach().numpy()),
-        "b_hh_l0": jnp.array(model.lstm.bias_hh_l0.detach().numpy()),
-        "alpha_head": {
-            "kernel": jnp.array(model.alpha.weight.detach().numpy().T),
-            "bias": jnp.array(model.alpha.bias.detach().numpy()),
-        },
-        "beta_head": {
-            "kernel": jnp.array(model.beta.weight.detach().numpy().T),
-            "bias": jnp.array(model.beta.bias.detach().numpy()),
-        },
-    }
-    return params
+# One torch re-statement of the reference stack, shared by the 20-step
+# exact-trajectory test below and the epoch-scale harness.
+from torch_reference_stack import (  # noqa: E402
+    TorchReferenceStack,
+    flax_params_from_torch,
+)
 
 
 def make_batches(rng, n_steps):
@@ -118,7 +90,7 @@ def framework_trajectory(params, batches):
 
 def test_training_trajectories_match():
     torch.manual_seed(0)
-    model = TorchReferenceModel()
+    model = TorchReferenceStack(hidden_size=HIDDEN, num_layers=1, dropout=0.0)
     params = flax_params_from_torch(model)
     batches = make_batches(np.random.default_rng(7), N_STEPS)
 
@@ -128,3 +100,197 @@ def test_training_trajectories_match():
     np.testing.assert_allclose(f_losses, t_losses, rtol=2e-4)
     # The trajectory must actually move (optimizer engaged on both sides).
     assert t_losses[-1] != pytest.approx(t_losses[0])
+
+
+# --------------------------------------------------------------------------
+# Epoch-scale loss-curve parity — the BASELINE.md north-star claim
+# ("reproducing the experiment_synthetic.sh loss curves within 1%") as
+# tests, against the faithful torch re-statement of the reference stack
+# (tests/torch_reference_stack.py; reference: src/model.py:176-331,
+# train.py:169-198). Two complementary experiments:
+#
+# 1. EXACT parity: dropout off, shuffle order MATCHED (the torch loop
+#    consumes the framework's own stream-mode epoch iterator), so the two
+#    stacks see identical optimization problems. Full multi-epoch
+#    Trainer.fit — val cadence + ReduceLROnPlateau in the loop — must
+#    reproduce torch's train/val curves within a fraction of the 1%
+#    target, and make identical LR decisions.
+#
+# 2. DROPOUT-ACTIVE statistical parity: masks and shuffle order are
+#    necessarily different RNG draws across frameworks (SURVEY.md §7), and
+#    the same-framework noise floor (torch vs torch with different seeds)
+#    is itself measured at 1.4-3.2% at this scale — so "within 1%" is not
+#    a statistically meaningful bar for a single dropout-active run. The
+#    honest assertion: the cross-framework curve gap must be
+#    indistinguishable from same-framework RNG noise (<= 1.5x the measured
+#    torch-vs-torch envelope, and never worse than 1% + envelope).
+# --------------------------------------------------------------------------
+
+PARITY_EPOCHS = 8
+PARITY_LR = 1e-3
+PARITY_HIDDEN = 16  # the thesis' small hidden size (tex:1106-1122)
+
+
+@pytest.fixture(scope="module")
+def parity_dm(tmp_path_factory):
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+    from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+
+    data_dir = tmp_path_factory.mktemp("parity_data")
+    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+        n_stocks=8, n_samples=6000, seed=11
+    )
+    np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+    np.save(data_dir / "market.npy", np.asarray(r_market))
+    np.save(data_dir / "alphas.npy", np.asarray(alphas))
+    np.save(data_dir / "betas.npy", np.asarray(betas))
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24, batch_size=1
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    return dm
+
+
+def _torch_model_and_params(dropout):
+    from torch_reference_stack import (
+        TorchReferenceStack,
+        flax_params_from_torch,
+    )
+
+    torch.manual_seed(3)
+    tmodel = TorchReferenceStack(
+        hidden_size=PARITY_HIDDEN, num_layers=2, dropout=dropout
+    )
+    return tmodel, flax_params_from_torch(tmodel)
+
+
+def _framework_fit(parity_dm, objective, params, *, dropout, epoch_mode,
+                   seed=5, epochs=PARITY_EPOCHS):
+    from masters_thesis_tpu.train import Trainer
+
+    spec = ModelSpec(
+        objective=objective,
+        hidden_size=PARITY_HIDDEN,
+        num_layers=2,
+        dropout=dropout,
+        learning_rate=PARITY_LR,
+    )
+    trainer = Trainer(
+        max_epochs=epochs,
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=1,
+        strategy="single_device",
+        epoch_mode=epoch_mode,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=seed,
+    )
+    result = trainer.fit(spec, parity_dm, init_state=(params, None))
+    return [
+        {
+            "train": row["loss/total/train"],
+            "val": row["loss/total/val"],
+            "lr": row["lr-Adam"],
+        }
+        for row in result.history
+    ]
+
+
+def _curve_gap(a, b, key):
+    """Max per-epoch relative deviation between two histories."""
+    xa = np.array([r[key] for r in a])
+    xb = np.array([r[key] for r in b])
+    return float(np.max(np.abs(xa - xb) / np.abs(xa)))
+
+
+class TestEpochScaleLossCurveParity:
+    @pytest.mark.parametrize("objective", ["mse", "nll", "combined"])
+    def test_exact_curves_match(self, parity_dm, objective):
+        """Matched shuffle, dropout off: the full fit loop (val cadence +
+        plateau LR) reproduces the torch reference curves well inside the
+        1% north-star envelope."""
+        from torch_reference_stack import fit_reference
+
+        tmodel, params = _torch_model_and_params(dropout=0.0)
+        # The torch loop consumes the framework's OWN epoch iterator
+        # (stream mode shuffles host-side with seed (trainer.seed, epoch)),
+        # so both stacks step through identical window sequences.
+        seed = 5
+        t_hist = fit_reference(
+            tmodel,
+            parity_dm.train_arrays(),
+            parity_dm.val_arrays(),
+            objective,
+            epochs=PARITY_EPOCHS,
+            lr=PARITY_LR,
+            epoch_batches=lambda epoch: parity_dm._iterate(
+                parity_dm.train_range, 1, shuffle_seed=(seed, epoch)
+            ),
+        )
+        f_hist = _framework_fit(
+            parity_dm, objective, params, dropout=0.0, epoch_mode="stream",
+            seed=seed,
+        )
+        assert len(f_hist) == len(t_hist) == PARITY_EPOCHS
+        t_train = [r["train"] for r in t_hist]
+        np.testing.assert_allclose(
+            [r["train"] for r in f_hist], t_train, rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            [r["val"] for r in f_hist], [r["val"] for r in t_hist], rtol=1e-3
+        )
+        # The run must actually optimize (not a flat-curve vacuous match).
+        assert t_train[-1] < t_train[0]
+        # Identical reduce-on-plateau decisions epoch by epoch.
+        np.testing.assert_allclose(
+            [r["lr"] for r in f_hist], [r["lr"] for r in t_hist], rtol=1e-12
+        )
+
+    @pytest.mark.parametrize("objective", ["mse", "nll", "combined"])
+    def test_dropout_active_curves_within_rng_noise(self, parity_dm, objective):
+        """Dropout ACTIVE: cross-framework curve gap must be no worse than
+        same-framework RNG noise (torch vs torch, different mask/shuffle
+        seeds), i.e. the frameworks are statistically indistinguishable."""
+        import copy
+
+        from torch_reference_stack import fit_reference
+
+        tmodel, params = _torch_model_and_params(dropout=0.2)
+        replicas = [copy.deepcopy(tmodel) for _ in range(2)]
+        tr, va = parity_dm.train_arrays(), parity_dm.val_arrays()
+        t_hist = fit_reference(
+            tmodel, tr, va, objective, epochs=PARITY_EPOCHS, lr=PARITY_LR,
+            shuffle_seed=0,
+        )
+        # Same-framework noise envelope from independently-seeded torch
+        # replicas of the identical run (a 2-run estimate understates the
+        # max-deviation spread; 3 runs = 3 pairwise gaps).
+        t_replica_hists = []
+        for i, m in enumerate(replicas):
+            torch.manual_seed(100 + i)
+            t_replica_hists.append(
+                fit_reference(
+                    m, tr, va, objective, epochs=PARITY_EPOCHS, lr=PARITY_LR,
+                    shuffle_seed=1 + i,
+                )
+            )
+        f_hist = _framework_fit(
+            parity_dm, objective, params, dropout=0.2, epoch_mode="scan",
+        )
+        assert len(f_hist) == len(t_hist) == PARITY_EPOCHS
+        torch_runs = [t_hist] + t_replica_hists
+        for key in ("train", "val"):
+            envelope = max(
+                _curve_gap(a, b, key)
+                for i, a in enumerate(torch_runs)
+                for b in torch_runs[i + 1:]
+            )
+            gap = max(_curve_gap(t, f_hist, key) for t in torch_runs)
+            assert gap <= max(1.5 * envelope, 0.01 + envelope), (
+                f"{key} curve gap {gap:.4f} exceeds RNG-noise envelope "
+                f"{envelope:.4f}"
+            )
+        # Both stacks must actually learn.
+        assert t_hist[-1]["train"] < t_hist[0]["train"]
+        assert f_hist[-1]["train"] < f_hist[0]["train"]

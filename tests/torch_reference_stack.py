@@ -1,0 +1,190 @@
+"""A faithful torch re-statement of the reference training stack, for the
+epoch-scale cross-framework parity harness.
+
+This mirrors — without copying — what the reference's Lightning loop does
+per epoch (reference: src/model.py:72-331, train.py:169-198):
+
+- 2-head LSTM encoder: ``torch.nn.LSTM(input, hidden, layers, dropout,
+  batch_first)`` + two ``Linear(hidden, 1)`` heads on the last hidden state
+  (reference: src/model.py:88-109),
+- the three objectives — MSE on ``alpha + beta * r_market``, the
+  multivariate-Gaussian NLL with the Woodbury single-factor inverse
+  covariance, and Combined = NLL + mse_weight * MSE (reference:
+  src/model.py:176-331, src/common.py:50-78),
+- Adam(lr, weight_decay=1e-5) + gradient clipping + torch's own
+  ReduceLROnPlateau(factor .5, patience 2) stepped on the epoch's val loss
+  (reference: src/model.py:149-172, train.py:172),
+- shuffled batch_size=1-window epochs, eval with dropout off
+  (reference: src/data.py:236-244).
+
+The harness trains THIS stack and the JAX framework from identical initial
+weights on identical windows and requires the epoch loss curves to agree —
+the BASELINE.md north-star "loss curves within 1%" claim, as a test.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import torch
+
+CLIP = 5.0
+WEIGHT_DECAY = 1e-5
+
+
+class TorchReferenceStack(torch.nn.Module):
+    """Reference encoder shape (reference: src/model.py:88-109)."""
+
+    def __init__(self, input_size=3, hidden_size=16, num_layers=2, dropout=0.2):
+        super().__init__()
+        self.lstm = torch.nn.LSTM(
+            input_size,
+            hidden_size,
+            num_layers,
+            batch_first=True,
+            dropout=dropout if num_layers > 1 else 0.0,
+        )
+        self.alpha = torch.nn.Linear(hidden_size, 1)
+        self.beta = torch.nn.Linear(hidden_size, 1)
+
+    def forward(self, x):
+        out, _ = self.lstm(x)
+        final = out[:, -1, :]
+        return self.alpha(final), self.beta(final)
+
+
+def flax_params_from_torch(model: TorchReferenceStack) -> dict:
+    """Copy torch weights into the LstmEncoder param tree (any layer count).
+
+    jnp.array (copy), NOT asarray: ``.numpy()`` aliases the torch buffer,
+    which ``opt.step()`` mutates in place.
+    """
+    import jax.numpy as jnp
+
+    params: dict = {}
+    for layer in range(model.lstm.num_layers):
+        for t_name, f_name in (
+            ("weight_ih", "w_ih"),
+            ("weight_hh", "w_hh"),
+            ("bias_ih", "b_ih"),
+            ("bias_hh", "b_hh"),
+        ):
+            t = getattr(model.lstm, f"{t_name}_l{layer}")
+            params[f"{f_name}_l{layer}"] = jnp.array(t.detach().numpy())
+    for head, name in ((model.alpha, "alpha_head"), (model.beta, "beta_head")):
+        params[name] = {
+            "kernel": jnp.array(head.weight.detach().numpy().T),
+            "bias": jnp.array(head.bias.detach().numpy()),
+        }
+    return params
+
+
+def window_loss(model, x, y, factor, inv_psi, objective, mse_weight=100.0):
+    """One window's training loss (reference: src/model.py:192-202 MSE,
+    :234-249 NLL via src/common.py:50-78 Woodbury, :308-319 combined)."""
+    alpha, beta = model(x)  # (K, 1) each
+    r_target = y[:, :, 0]  # (K, T)
+    r_market = y[:, :, 1]
+    mse = torch.nn.functional.mse_loss(alpha + beta * r_market, r_target)
+    if objective == "mse":
+        return mse
+    f_mean, f_var = factor[0], factor[1]
+    mu = alpha + beta * f_mean  # (K, 1)
+    psi_inv = torch.diag(inv_psi)
+    denom = 1.0 / f_var + beta.T @ psi_inv @ beta
+    sigma_inv = psi_inv - (psi_inv @ beta @ beta.T @ psi_inv) / denom
+    diff = r_target - mu  # (K, n)
+    k, n = diff.shape
+    nll = 0.5 * (
+        n * (k * math.log(2.0 * math.pi) - torch.logdet(sigma_inv))
+        + torch.sum((sigma_inv @ diff) * diff)
+    )
+    if objective == "nll":
+        return nll
+    return nll + mse_weight * mse
+
+
+def _window(arrays, i):
+    x = torch.from_numpy(np.asarray(arrays.x[i]))
+    y = torch.from_numpy(np.asarray(arrays.y[i]))
+    factor = torch.from_numpy(np.asarray(arrays.factor[i]))
+    inv_psi = torch.from_numpy(np.asarray(arrays.inv_psi[i]))
+    return x, y, factor, inv_psi
+
+
+def fit_reference(
+    model: TorchReferenceStack,
+    train_arrays,
+    val_arrays,
+    objective: str,
+    *,
+    epochs: int,
+    lr: float,
+    mse_weight: float = 100.0,
+    shuffle_seed: int = 0,
+    epoch_batches=None,
+) -> list[dict]:
+    """Train the torch stack the way the reference's Lightning loop would;
+    returns per-epoch rows {train, val, lr} (epoch-mean losses).
+
+    ``epoch_batches``: optional ``fn(epoch) -> iterator of batch_size=1
+    Batch pytrees`` — lets the exact-parity harness feed torch the
+    framework's OWN epoch iterator so both stacks see identical window
+    sequences (cross-framework RNG replication being impossible otherwise).
+    """
+    opt = torch.optim.Adam(model.parameters(), lr=lr, weight_decay=WEIGHT_DECAY)
+    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
+        opt, factor=0.5, patience=2
+    )
+    rng = np.random.default_rng(shuffle_seed)
+    n_train = train_arrays.x.shape[0]
+    n_val = val_arrays.x.shape[0]
+    history = []
+    for epoch in range(epochs):
+        model.train()
+        losses = []
+        if epoch_batches is not None:
+            windows = (
+                tuple(
+                    torch.from_numpy(np.asarray(leaf[0]))
+                    for leaf in (b.x, b.y, b.factor, b.inv_psi)
+                )
+                for b in epoch_batches(epoch)
+            )
+        else:
+            windows = (
+                _window(train_arrays, i) for i in rng.permutation(n_train)
+            )
+        for w in windows:
+            loss = window_loss(model, *w, objective, mse_weight)
+            opt.zero_grad()
+            loss.backward()
+            # Lightning clips raw grads before the step (train.py:172).
+            torch.nn.utils.clip_grad_norm_(model.parameters(), CLIP)
+            opt.step()
+            losses.append(float(loss.detach()))
+        model.eval()
+        with torch.no_grad():
+            val = float(
+                np.mean(
+                    [
+                        float(
+                            window_loss(
+                                model, *_window(val_arrays, i), objective,
+                                mse_weight,
+                            )
+                        )
+                        for i in range(n_val)
+                    ]
+                )
+            )
+        sched.step(val)
+        history.append(
+            {
+                "train": float(np.mean(losses)),
+                "val": val,
+                "lr": opt.param_groups[0]["lr"],
+            }
+        )
+    return history
