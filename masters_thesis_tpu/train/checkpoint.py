@@ -93,3 +93,16 @@ def restore_checkpoint(
 def restore_opt_state(template: Any, raw: Any) -> Any:
     """Rebuild an optax state pytree from its checkpointed state dict."""
     return fser.from_state_dict(template, raw)
+
+
+def apply_datamodule_sidecar(cfg, meta: dict) -> None:
+    """Overwrite cfg.datamodule's window hparams from a checkpoint's meta.
+
+    Evaluation must window the data exactly the way the checkpoint was
+    trained (lookback/target/stride/...); ``data_dir`` and ``engine`` stay
+    config-driven — they are environment-, not model-specific. Shared by
+    test.py and sweeps/eval_cell.py so the invariant lives in one place.
+    """
+    for key, value in meta.get("datamodule", {}).items():
+        if key in cfg.datamodule:
+            cfg.datamodule[key] = value

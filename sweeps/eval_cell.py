@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Collect one trained grid cell's thesis-table quality numbers as JSON.
+
+A headless, figure-free subset of test.py: restore the checkpoint, rebuild
+the datamodule it was trained on, compute the ΔL-above-OLS metrics
+(reference: tex/diplomski_rad.tex:1077-1084, 1155-1176) and print ONE JSON
+line. Used by sweeps/run_grid_canonical.py to build the RESULTS.md table.
+
+Usage: python sweeps/eval_cell.py checkpoint=<dir> [overrides...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from train import CONFIG_DIR, bootstrap, build_datamodule  # noqa: E402
+from masters_thesis_tpu.config import compose  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("overrides", nargs="*", help="key=value overrides")
+    args = parser.parse_args()
+    cfg = compose(str(CONFIG_DIR), overrides=args.overrides)
+    assert cfg.checkpoint, "checkpoint=<dir> override required"
+
+    from masters_thesis_tpu.evaluation import delta_losses
+    from masters_thesis_tpu.train.checkpoint import (
+        apply_datamodule_sidecar,
+        restore_checkpoint,
+    )
+    from masters_thesis_tpu.utils import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+    params, _, spec, meta = restore_checkpoint(Path(cfg.checkpoint))
+    # Evaluate on the SAME windowing the checkpoint was trained with.
+    apply_datamodule_sidecar(cfg, meta)
+    if not bootstrap(cfg):
+        raise SystemExit("bootstrap failed")
+    dm = build_datamodule(cfg)
+    dm.prepare_data(verbose=False)
+    deltas = delta_losses(spec, params, dm)
+    print(
+        json.dumps(
+            {
+                "checkpoint": str(cfg.checkpoint),
+                "objective": spec.objective,
+                "num_layers": spec.num_layers,
+                "epoch": meta.get("epoch"),
+                "val_loss": meta.get("val_loss"),
+                "zeta": deltas["zeta"],
+                "model": deltas["model"],
+                "ols": deltas["ols"],
+                "baseline": deltas["baseline"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Canonical-scale experiment grid runner (relay-wedge resilient).
+
+Runs the reference's full 18-cell `experiment_synthetic.sh` grid —
+model=small,medium,large x loss=mse,nll,combined x trainer=slow,slowest —
+at the canonical 1M-sample bootstrap (reference:
+sweeps/experiment_synthetic.sh, train.py:32), plus the thesis' warmup
+protocol (synthetic -> fine-tune; real Fama-French CSVs cannot be
+downloaded in this environment, so the fine-tune target is the DGP's
+"outliers" variant — the same pretrain-then-adapt protocol on data this
+environment can generate; reference: tex/diplomski_rad.tex:1134-1147).
+
+Engineering constraints this runner absorbs:
+
+- The TPU relay lease can wedge for long stretches: every cell waits for a
+  subprocess device probe to pass before launching, and sleeps/retries
+  while wedged.
+- Cells run cheapest-first (slow column, then warmup, then slowest column
+  small->large) so a wall-clock cutoff loses the most expensive cells
+  last; `--deadline` stops LAUNCHING new cells and caps each cell's
+  subprocess timeout.
+- Every cell trains with trainer.resume=true, so re-running this script
+  resumes truncated cells from their last val-epoch checkpoint instead of
+  restarting; completed cells are skipped via the results JSONL.
+
+Results: one JSON line per finished cell in results/grid_r3.jsonl
+(training wall, best-val, and the ΔL-above-OLS table numbers via
+sweeps/eval_cell.py).
+
+Usage:
+    nohup python sweeps/run_grid_canonical.py \
+        --deadline 2026-07-30T06:30 > results/grid_r3_runner.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RESULTS_DIR = REPO / "results"
+OUT = RESULTS_DIR / "grid_r3.jsonl"
+
+MODELS = ("small", "medium", "large")
+LOSSES = ("mse", "nll", "combined")
+PER_CELL_CAP_S = 3 * 3600
+
+
+def log(msg: str) -> None:
+    print(f"{datetime.datetime.now():%H:%M:%S} {msg}", flush=True)
+
+
+def tpu_ready() -> bool:
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=90,
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def wait_for_tpu(deadline: float) -> bool:
+    while time.time() < deadline - 300:
+        if tpu_ready():
+            return True
+        log("TPU relay not ready; retrying in 60s")
+        time.sleep(60)
+    return False
+
+
+def done_cells() -> set:
+    """Cells with a COMPLETE recorded run. Truncated rows don't count: a
+    re-run resumes them from their last checkpoint and appends a fresher
+    row (consumers take the last row per cell)."""
+    if not OUT.exists():
+        return set()
+    done = set()
+    for line in OUT.read_text().splitlines():
+        if line.strip():
+            row = json.loads(line)
+            if not row.get("truncated"):
+                done.add(row["cell"])
+    return done
+
+
+def version_for(loss: str, model: str, trainer: str) -> str:
+    return f"{loss}_{model}_lr0.0001_{trainer}"
+
+
+def run_cell(
+    cell: str,
+    train_overrides: list[str],
+    ckpt: Path,
+    eval_overrides: list[str],
+    deadline: float,
+) -> None:
+    if cell in done_cells():
+        log(f"skip {cell}: already recorded")
+        return
+    if not wait_for_tpu(deadline):
+        log(f"skip {cell}: TPU never became ready before deadline")
+        return
+    # Budget AFTER the TPU wait: a long wedge must shrink the cell's cap,
+    # not let the subprocess run past the deadline.
+    budget = min(PER_CELL_CAP_S, deadline - time.time())
+    if budget < 300:
+        log(f"skip {cell}: deadline reached")
+        return
+
+    log(f"train {cell}")
+    t0 = time.time()
+    truncated = False
+    try:
+        train = subprocess.run(
+            [sys.executable, "train.py", *train_overrides,
+             "trainer.resume=true", "trainer.enable_model_summary=false"],
+            cwd=REPO,
+            timeout=budget,
+            capture_output=True,
+            text=True,
+        )
+        if train.returncode != 0:
+            log(f"{cell}: train FAILED rc={train.returncode}\n"
+                f"{train.stdout[-1500:]}\n{train.stderr[-1500:]}")
+            return
+    except subprocess.TimeoutExpired:
+        truncated = True
+        log(f"{cell}: train hit the {budget:.0f}s cap; evaluating the last "
+            "checkpoint (resume will continue it on a re-run)")
+    wall = time.time() - t0
+
+    if not ckpt.exists():
+        log(f"{cell}: no checkpoint at {ckpt}; nothing to record")
+        return
+    try:
+        ev = subprocess.run(
+            [sys.executable, "sweeps/eval_cell.py", f"checkpoint={ckpt}",
+             *eval_overrides],
+            cwd=REPO,
+            timeout=1800,
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
+        err = getattr(exc, "stderr", "") or ""
+        log(f"{cell}: eval failed ({type(exc).__name__})\n{err[-1500:]}")
+        return
+    row = json.loads(ev.stdout.strip().splitlines()[-1])
+    row.update({"cell": cell, "train_wall_s": round(wall, 1),
+                "truncated": truncated})
+    RESULTS_DIR.mkdir(exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    log(f"{cell}: recorded (wall {wall:.0f}s, truncated={truncated})")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--deadline", required=True,
+        help="ISO time (local) after which no new cells launch",
+    )
+    args = parser.parse_args()
+    deadline = datetime.datetime.fromisoformat(args.deadline).timestamp()
+    log(f"grid runner start; deadline {args.deadline} "
+        f"({(deadline - time.time()) / 3600:.1f}h away)")
+
+    # ---- 1. slow column, cheapest models first --------------------------
+    for model in MODELS:
+        for loss in LOSSES:
+            cell = f"{loss}_{model}_slow"
+            ckpt = (REPO / "logs/FinancialLstm/synthetic"
+                    / version_for(loss, model, "slow") / "checkpoints/best")
+            run_cell(
+                cell,
+                [f"model={model}", f"loss={loss}", "trainer=slow"],
+                ckpt,
+                ["datamodule=synthetic"],
+                deadline,
+            )
+
+    # ---- 2. warmup protocol (pretrain variant -> outliers variant) ------
+    pre = (REPO / "logs/FinancialLstm/synthetic"
+           / version_for("combined", "large", "slow") / "checkpoints/best")
+    outlier_ov = [
+        "datamodule.dgp_variant=outliers",
+        "datamodule.data_dir=data/synthetic_outliers",
+    ]
+    if pre.exists():
+        for loss in LOSSES:
+            # From-scratch baseline on the fine-tune dataset...
+            run_cell(
+                f"outliers_{loss}_large_scratch",
+                ["model=large", f"loss={loss}", "trainer=slow", *outlier_ov,
+                 "logger.name=FinancialLstm/outliers"],
+                REPO / "logs/FinancialLstm/outliers"
+                / version_for(loss, "large", "slow") / "checkpoints/best",
+                outlier_ov,
+                deadline,
+            )
+            # ...vs warm-started from the synthetic-pretrained weights
+            # (fresh optimizer: checkpoint_mode=params).
+            run_cell(
+                f"outliers_{loss}_large_warmup",
+                ["model=large", f"loss={loss}", "trainer=slow", *outlier_ov,
+                 f"checkpoint={pre}", "checkpoint_mode=params",
+                 "logger.name=FinancialLstm/warmup"],
+                REPO / "logs/FinancialLstm/warmup"
+                / version_for(loss, "large", "slow") / "checkpoints/best",
+                outlier_ov,
+                deadline,
+            )
+    else:
+        log("warmup block skipped: pretrain checkpoint missing")
+
+    # ---- 3. slowest column, cheapest models first -----------------------
+    for model in MODELS:
+        for loss in LOSSES:
+            cell = f"{loss}_{model}_slowest"
+            ckpt = (REPO / "logs/FinancialLstm/synthetic"
+                    / version_for(loss, model, "slowest") / "checkpoints/best")
+            run_cell(
+                cell,
+                [f"model={model}", f"loss={loss}", "trainer=slowest"],
+                ckpt,
+                ["datamodule=synthetic"],
+                deadline,
+            )
+
+    log("grid runner finished")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,10 @@ def main(argv: list[str] | None = None) -> None:
         return
 
     from masters_thesis_tpu.evaluation import collect_test_results, delta_losses
-    from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+    from masters_thesis_tpu.train.checkpoint import (
+        apply_datamodule_sidecar,
+        restore_checkpoint,
+    )
     from masters_thesis_tpu.utils import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()
@@ -64,12 +67,8 @@ def main(argv: list[str] | None = None) -> None:
     )
 
     params, _, spec, meta = restore_checkpoint(Path(cfg.checkpoint))
-    # Evaluate on the SAME windowing the checkpoint was trained with: the
-    # sidecar's datamodule hparams override the composed config (data_dir
-    # stays config-driven — it is environment-, not model-specific).
-    for key, value in meta.get("datamodule", {}).items():
-        if key in cfg.datamodule:
-            cfg.datamodule[key] = value
+    # Evaluate on the SAME windowing the checkpoint was trained with.
+    apply_datamodule_sidecar(cfg, meta)
     if not bootstrap(cfg):
         return
     dm = build_datamodule(cfg)

@@ -96,6 +96,62 @@ def test_eval_cli_without_checkpoint_exits_cleanly(cli_run, capsys):
     assert "No model checkpoint found" in capsys.readouterr().err
 
 
+def test_warmup_checkpoint_keeps_config_objective(tmp_path):
+    """checkpoint_mode=params must fine-tune under the CONFIG's objective,
+    not the pretrain checkpoint's: the thesis warmup protocol fine-tunes a
+    combined-pretrained model under each of the three losses
+    (sweeps/experiment_warmup.sh; reference: tex/diplomski_rad.tex:1134-1147).
+    Regression: run() used to rebind the spec from the restored checkpoint,
+    silently training the pretrain objective for every fine-tune."""
+    base = [
+        "trainer=fast",
+        "trainer.max_epochs=1",
+        "trainer.enable_progress_bar=false",
+        "trainer.enable_model_summary=false",
+        "model.hidden_size=8",
+        "model.num_layers=1",
+        "datamodule.n_samples=8000",
+        "datamodule.n_stocks=4",
+        f"datamodule.data_dir={tmp_path}/data",
+        f"logger.save_dir={tmp_path}/logs",
+    ]
+    train_mod._run_job(
+        str(_REPO_ROOT / "configs"),
+        base + ["loss=combined", "logger.version=pre"],
+    )
+    pre = (
+        tmp_path / "logs" / "FinancialLstm" / "synthetic" / "pre"
+        / "checkpoints" / "best"
+    )
+    assert pre.exists()
+    train_mod._run_job(
+        str(_REPO_ROOT / "configs"),
+        base + [
+            "loss=nll", f"checkpoint={pre}", "checkpoint_mode=params",
+            "logger.version=warm",
+        ],
+    )
+    from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+
+    warm = (
+        tmp_path / "logs" / "FinancialLstm" / "synthetic" / "warm"
+        / "checkpoints"
+    )
+    _, _, spec, _ = restore_checkpoint(warm, "last")
+    assert spec.objective == "nll"
+
+    # And a mismatched architecture must fail loudly, not load garbage.
+    with pytest.raises(ValueError, match="matching architecture"):
+        train_mod._run_job(
+            str(_REPO_ROOT / "configs"),
+            base + [
+                "loss=nll", "model.hidden_size=4",
+                f"checkpoint={pre}", "checkpoint_mode=params",
+                "logger.version=warm_bad",
+            ],
+        )
+
+
 def test_multirun_numbered_job_dirs(tmp_path, capsys, monkeypatch):
     """With a relative logger.save_dir, every sweep point writes into a
     numbered Hydra-style job dir <sweep_dir>/<job_idx>/ carrying .hydra

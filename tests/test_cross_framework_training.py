@@ -32,6 +32,7 @@ N_STEPS = 20
 # exact-trajectory test below and the epoch-scale harness.
 from torch_reference_stack import (  # noqa: E402
     TorchReferenceStack,
+    fit_reference,
     flax_params_from_torch,
 )
 
@@ -153,11 +154,6 @@ def parity_dm(tmp_path_factory):
 
 
 def _torch_model_and_params(dropout):
-    from torch_reference_stack import (
-        TorchReferenceStack,
-        flax_params_from_torch,
-    )
-
     torch.manual_seed(3)
     tmodel = TorchReferenceStack(
         hidden_size=PARITY_HIDDEN, num_layers=2, dropout=dropout
@@ -210,12 +206,11 @@ class TestEpochScaleLossCurveParity:
         """Matched shuffle, dropout off: the full fit loop (val cadence +
         plateau LR) reproduces the torch reference curves well inside the
         1% north-star envelope."""
-        from torch_reference_stack import fit_reference
-
         tmodel, params = _torch_model_and_params(dropout=0.0)
         # The torch loop consumes the framework's OWN epoch iterator
-        # (stream mode shuffles host-side with seed (trainer.seed, epoch)),
-        # so both stacks step through identical window sequences.
+        # (stream mode shuffles host-side with seed (trainer.seed, epoch);
+        # train_batches is that exact public contract at batch_size=1), so
+        # both stacks step through identical window sequences.
         seed = 5
         t_hist = fit_reference(
             tmodel,
@@ -224,8 +219,8 @@ class TestEpochScaleLossCurveParity:
             objective,
             epochs=PARITY_EPOCHS,
             lr=PARITY_LR,
-            epoch_batches=lambda epoch: parity_dm._iterate(
-                parity_dm.train_range, 1, shuffle_seed=(seed, epoch)
+            epoch_batches=lambda epoch: parity_dm.train_batches(
+                epoch=epoch, seed=seed
             ),
         )
         f_hist = _framework_fit(
@@ -253,8 +248,6 @@ class TestEpochScaleLossCurveParity:
         same-framework RNG noise (torch vs torch, different mask/shuffle
         seeds), i.e. the frameworks are statistically indistinguishable."""
         import copy
-
-        from torch_reference_stack import fit_reference
 
         tmodel, params = _torch_model_and_params(dropout=0.2)
         replicas = [copy.deepcopy(tmodel) for _ in range(2)]

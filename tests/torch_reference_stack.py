@@ -145,13 +145,18 @@ def fit_reference(
         model.train()
         losses = []
         if epoch_batches is not None:
-            windows = (
-                tuple(
+
+            def one_window(b):
+                # The harness is batch_size=1 only; silently taking leaf[0]
+                # from a bigger batch would train torch on a fraction of
+                # the stream and void the parity premise.
+                assert b.x.shape[0] == 1, f"batch_size=1 only, got {b.x.shape[0]}"
+                return tuple(
                     torch.from_numpy(np.asarray(leaf[0]))
                     for leaf in (b.x, b.y, b.factor, b.inv_psi)
                 )
-                for b in epoch_batches(epoch)
-            )
+
+            windows = (one_window(b) for b in epoch_batches(epoch))
         else:
             windows = (
                 _window(train_arrays, i) for i in rng.permutation(n_train)

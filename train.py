@@ -168,16 +168,35 @@ def run(cfg: Config) -> float:
     if cfg.checkpoint:
         from masters_thesis_tpu.train.checkpoint import restore_checkpoint
 
-        params, opt_state, spec, _ = restore_checkpoint(Path(cfg.checkpoint))
-        # 'params' = warmup protocol: reuse weights, fresh optimizer
-        # (reference: tex/diplomski_rad.tex:1134-1147 — synthetic-trained
-        # model continued on real data).
         mode = cfg.get("checkpoint_mode", "full")
         if mode not in ("full", "params"):
             raise ValueError(
                 f"checkpoint_mode must be 'full' or 'params', got {mode!r}"
             )
-        init_state = (params, opt_state if mode == "full" else None)
+        params, opt_state, ckpt_spec, _ = restore_checkpoint(
+            Path(cfg.checkpoint)
+        )
+        if mode == "full":
+            # Exact resume: the checkpoint's spec (objective, lr, ...) wins.
+            spec = ckpt_spec
+            init_state = (params, opt_state)
+        else:
+            # 'params' = warmup protocol: reuse weights, fresh optimizer —
+            # and the CONFIG keeps deciding the objective/lr/dropout (the
+            # thesis fine-tunes a combined-pretrained model under each of
+            # the three losses; tex/diplomski_rad.tex:1134-1147,
+            # sweeps/experiment_warmup.sh). Only the weight shapes must
+            # match the checkpoint.
+            if (ckpt_spec.hidden_size, ckpt_spec.num_layers) != (
+                spec.hidden_size, spec.num_layers,
+            ):
+                raise ValueError(
+                    "checkpoint_mode=params needs a matching architecture: "
+                    f"checkpoint is hidden={ckpt_spec.hidden_size}/"
+                    f"layers={ckpt_spec.num_layers}, config asks "
+                    f"hidden={spec.hidden_size}/layers={spec.num_layers}"
+                )
+            init_state = (params, None)
 
     result = trainer.fit(spec, dm, init_state=init_state)
     test_metrics = trainer.test(spec, result.params, dm)
