@@ -14,6 +14,8 @@ from typing import Iterable, Iterator, Any
 
 import jax
 
+from masters_thesis_tpu.parallel import global_put
+
 
 def prefetch_to_device(
     iterator: Iterable[Any], size: int = 2, sharding=None
@@ -34,7 +36,11 @@ def prefetch_to_device(
 
     def put(item):
         if sharding is not None:
-            return jax.device_put(item, sharding)
+            # global_put == device_put on a single-process mesh; on a
+            # multi-process mesh it materializes each process's shards from
+            # the (host-identical) full batch, which plain device_put would
+            # reject — this is what makes stream mode multi-host capable.
+            return global_put(item, sharding)
         return jax.device_put(item)
 
     it = iter(iterator)

@@ -50,12 +50,17 @@ def main() -> None:
     # the others block on the dgp.json completion marker (the rendezvous
     # that was previously only ever monkeypatch-simulated).
     data_dir = workdir / "data"
-    bootstrap_synthetic(data_dir, n_stocks=4, n_samples=4000, seed=0)
+    # 3820 samples -> 159 windows -> train split 111, which is ODD: with a
+    # global batch of 2 (1 window x 2 processes) the stream run below hits
+    # the weight-masked tail-batch path cross-process, not just full
+    # batches.
+    bootstrap_synthetic(data_dir, n_stocks=4, n_samples=3820, seed=0)
     dm = FinancialWindowDataModule(
         data_dir, lookback_window=16, target_window=8, stride=24, batch_size=1
     )
     dm.prepare_data(verbose=False)
     dm.setup()
+    assert len(dm.train_range) % 2 == 1  # forces a stream tail batch
 
     trainer = Trainer(
         max_epochs=2,
@@ -74,6 +79,21 @@ def main() -> None:
     result = trainer.fit(spec, dm)
     test_metrics = trainer.test(spec, result.params, dm)
 
+    # Stream mode across processes too: host iterator -> global_put
+    # prefetch -> pjit step over the cross-process mesh (incl. the
+    # weight-masked tail batch).
+    stream_trainer = Trainer(
+        max_epochs=1,
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=1,
+        strategy="tpu_xla",
+        epoch_mode="stream",
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+    stream = stream_trainer.fit(spec, dm)
+
     leaves = jax.tree_util.tree_leaves(jax.device_get(result.params))
     np.savez(workdir / f"rank{rank}.npz", *[np.asarray(l) for l in leaves])
     (workdir / f"rank{rank}.json").write_text(
@@ -82,6 +102,7 @@ def main() -> None:
                 "history": result.history,
                 "best_val": result.best_val_loss,
                 "test": test_metrics,
+                "stream_history": stream.history,
                 "process_count": jax.process_count(),
                 "n_dev": trainer.n_dev,
             }

@@ -71,6 +71,10 @@ def test_two_process_distributed_training(tmp_path):
     # Same program, same psum'd grads => identical history on every rank.
     assert meta[0]["history"] == meta[1]["history"]
     assert meta[0]["history"]  # non-empty
+    # Stream mode (host iterator + global_put prefetch) also runs across
+    # processes and agrees between ranks.
+    assert meta[0]["stream_history"] == meta[1]["stream_history"]
+    assert np.isfinite(meta[0]["stream_history"][0]["loss/total/train"])
 
     a = np.load(tmp_path / "rank0.npz")
     b = np.load(tmp_path / "rank1.npz")
