@@ -50,9 +50,26 @@ from jax.experimental.pallas import tpu as pltpu
 # Single-program threshold / fallback row tile. ~104 rows keeps the aliased
 # backward under ~12 MB of VMEM at the reference's largest shape (T=60,
 # H=64); the tiled fallback uses 32-row blocks (double-buffered by the grid
-# pipeline, so its budget is ~2x per-block bytes).
+# pipeline, so its budget is ~2x per-block bytes). The fallback tile is
+# env-tunable (MT_LSTM_ROW_TILE, multiple of 8): RESULTS.md's batch sweep
+# shows per-window efficiency halving when batches leave the single-program
+# regime, and a larger tile trades VMEM for bigger (tile, H) MXU matmuls —
+# measure on the target chip before changing the default.
 SINGLE_TILE_MAX_ROWS = 104
 ROW_TILE = 32
+
+
+def _fallback_row_tile() -> int:
+    raw = os.environ.get("MT_LSTM_ROW_TILE", str(ROW_TILE))
+    try:
+        tile = int(raw)
+    except ValueError:
+        tile = -1  # fall through to the descriptive error
+    if tile <= 0 or tile % 8:
+        raise ValueError(
+            f"MT_LSTM_ROW_TILE must be a positive multiple of 8, got {raw!r}"
+        )
+    return tile
 
 
 def _pad_rows(a: jax.Array, b_pad: int) -> jax.Array:
@@ -66,7 +83,7 @@ def _row_tile(b: int) -> int:
     b_pad8 = -(-b // 8) * 8
     if b_pad8 <= SINGLE_TILE_MAX_ROWS:
         return b_pad8
-    return ROW_TILE
+    return _fallback_row_tile()
 
 
 def _gate_math(gates):
@@ -133,7 +150,9 @@ def _fwd_pallas(x_proj, w_hh_t, *, interpret):
         ],
         interpret=interpret,
     )(x_padded, w_hh_t)
-    return hs[:, :b], (x_padded, hs, cs, w_hh_t, b)
+    # tile rides the residuals: the backward grid must use the SAME tile
+    # the forward padded for, even if MT_LSTM_ROW_TILE changes in between.
+    return hs[:, :b], (x_padded, hs, cs, w_hh_t, b, tile)
 
 
 # ---------------------------------------------------------------- backward
@@ -197,11 +216,10 @@ def _bwd_kernel(
 
 
 def _bwd_pallas(interpret, residuals, dhs):
-    x_padded, hs, cs, w_hh_t, b = residuals
+    x_padded, hs, cs, w_hh_t, b, tile = residuals
     n_t, b_pad, four_h = x_padded.shape
     hidden = four_h // 4
     dhs = _pad_rows(dhs, b_pad)
-    tile = _row_tile(b)
     grid = (b_pad // tile,)
 
     row_block = lambda width: pl.BlockSpec(  # noqa: E731

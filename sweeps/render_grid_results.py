@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Render results/grid_r3.jsonl into the RESULTS.md ΔL markdown table.
+
+Takes the LAST row per cell (earlier rows may be truncated runs that a
+re-run of sweeps/run_grid_canonical.py resumed). Prints markdown to stdout;
+paste/commit into RESULTS.md. The ΔL convention matches the thesis table
+(reference: tex/diplomski_rad.tex:1155-1176): ΔL_MSE reported ×1e-5,
+ΔL_MIX with ζ=1e5.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent.parent / "results" / "grid_r3.jsonl"
+
+
+def load_cells() -> tuple[dict, float]:
+    """(last row per cell, total wall across ALL rows — truncated runs that
+    were later resumed each contributed real compute)."""
+    cells: dict = {}
+    total_wall = 0.0
+    for line in OUT.read_text().splitlines():
+        if line.strip():
+            row = json.loads(line)
+            cells[row["cell"]] = row  # last row per cell wins
+            total_wall += row.get("train_wall_s", 0)
+    return cells, total_wall
+
+
+def fmt(row: dict, who: str) -> str:
+    d = row[who]
+    return (
+        f"{d['delta_mse'] * 1e5:.3f} | {d['delta_nll']:.3f} | "
+        f"{d['delta_mix']:.3f}"
+    )
+
+
+def main() -> None:
+    cells, total_wall = load_cells()
+    if not cells:
+        sys.exit("no recorded cells")
+
+    print("| Cell | epochs | ΔL_MSE(×1e-5) | ΔL_NLL | ΔL_MIX(ζ=1e5) | "
+          "OLS ΔL_MSE | OLS ΔL_NLL | OLS ΔL_MIX |")
+    print("|---|---|---|---|---|---|---|---|")
+    order = sorted(cells)
+    for name in order:
+        row = cells[name]
+        epochs = (row.get("epoch", "?"), "T" if row.get("truncated") else "")
+        print(
+            f"| {name} | {epochs[0]}{epochs[1]} | {fmt(row, 'model')} | "
+            f"{fmt(row, 'ols')} |"
+        )
+    print(f"\n{len(cells)} cells; total train wall {total_wall / 3600:.2f}h "
+          "(all runs incl. resumed); truncated: "
+          f"{sum(1 for r in cells.values() if r.get('truncated'))}")
+
+
+if __name__ == "__main__":
+    main()

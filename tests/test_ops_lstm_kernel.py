@@ -84,6 +84,34 @@ def test_encoder_parity_between_impls(rng):
     np.testing.assert_allclose(np.asarray(b2), np.asarray(b1), atol=1e-5)
 
 
+def test_row_tile_env_override_parity(rng, monkeypatch):
+    """MT_LSTM_ROW_TILE retunes the grid-fallback block size; any legal
+    tile must be numerically identical to the default (fwd AND bwd)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x_proj = jnp.asarray(rng.normal(size=(4, 150, 64)).astype(np.float32))
+    w_hh_t = jnp.asarray(rng.normal(size=(16, 64)).astype(np.float32))
+
+    def loss(xp, w):
+        return jnp.sum(lstm_recurrence(xp, w, impl="interpret") ** 2)
+
+    base = jax.value_and_grad(loss, argnums=(0, 1))(x_proj, w_hh_t)
+    monkeypatch.setenv("MT_LSTM_ROW_TILE", "64")
+    tuned = jax.value_and_grad(loss, argnums=(0, 1))(x_proj, w_hh_t)
+    np.testing.assert_allclose(float(base[0]), float(tuned[0]), rtol=1e-6)
+    for a, b in zip(base[1], tuned[1]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+    monkeypatch.setenv("MT_LSTM_ROW_TILE", "31")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="multiple of 8"):
+        lstm_recurrence(x_proj, w_hh_t, impl="interpret").block_until_ready()
+
+
 def test_auto_falls_back_to_xla_on_cpu(rng):
     x_proj, w_hh_t = _random_case(rng, 4, 3, 8)
     out = lstm_recurrence(x_proj, w_hh_t, impl="auto")
