@@ -1,0 +1,17 @@
+#!/bin/bash
+# The thesis' own experiment grid (reference: tex/diplomski_rad.tex:1106-1122
+# — hidden in {16,32}, layers in {1,2}, lr in {1e-3..1e-6}, all three
+# objectives), as a runnable sweep. The reference repo never shipped this
+# grid as code (its sweeps use the small/medium/large config groups instead;
+# SURVEY.md §2.3 "code wins" note) — provided here because the thesis table
+# is the published quality baseline (BASELINE.md).
+#
+# 2 x 2 x 4 x 3 = 48 jobs per datamodule. Pass datamodule=real for the
+# Fama-French variant once the CSVs are present (bootstrap_real).
+python train.py -m datamodule=synthetic \
+    model.hidden_size=16,32 \
+    model.num_layers=1,2 \
+    model.learning_rate=1e-3,1e-4,1e-5,1e-6 \
+    loss=mse,nll,combined \
+    trainer=slow \
+    "$@"

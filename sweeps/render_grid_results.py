@@ -22,6 +22,8 @@ def load_cells() -> tuple[dict, float]:
     were later resumed each contributed real compute)."""
     cells: dict = {}
     total_wall = 0.0
+    if not OUT.exists():
+        return cells, total_wall
     for line in OUT.read_text().splitlines():
         if line.strip():
             row = json.loads(line)

@@ -187,6 +187,39 @@ def test_multirun_numbered_job_dirs(tmp_path, capsys, monkeypatch):
         assert (versions[0] / "checkpoints" / "best").exists()
 
 
+def test_multirun_parallel_launcher_numbered_dirs(tmp_path, capsys, monkeypatch):
+    """launcher=joblib worker processes also write the numbered Hydra-style
+    job dirs when save_dir is relative (the sweep_dir plumbing survives
+    cloudpickle into the pool)."""
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.chdir(tmp_path)
+    train_mod.main([
+        "-m",
+        "trainer=fast",
+        "trainer.max_epochs=1",
+        "trainer.enable_progress_bar=false",
+        "trainer.enable_model_summary=false",
+        "model.hidden_size=4,8",
+        "model.num_layers=1",
+        "datamodule.n_samples=8000",
+        "datamodule.n_stocks=4",
+        f"datamodule.data_dir={tmp_path}/data",
+        "logger.save_dir=logs",
+        "launcher=joblib",
+        "launcher.n_jobs=2",
+        "launcher.sweep_dir=sweep",
+    ])
+    for i in (0, 1):
+        job = tmp_path / "sweep" / str(i)
+        assert (job / ".hydra" / "overrides.yaml").exists()
+        versions = list(
+            (job / "logs" / "FinancialLstm" / "synthetic").iterdir()
+        )
+        assert len(versions) == 1
+        assert (versions[0] / "checkpoints" / "best").exists()
+
+
 def test_multirun_parallel_launcher(tmp_path, capsys, monkeypatch):
     """`-m` with launcher.n_jobs=2 runs each sweep point in its own worker
     process (the reference's joblib launcher semantics,

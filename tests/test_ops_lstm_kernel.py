@@ -87,10 +87,6 @@ def test_encoder_parity_between_impls(rng):
 def test_row_tile_env_override_parity(rng, monkeypatch):
     """MT_LSTM_ROW_TILE retunes the grid-fallback block size; any legal
     tile must be numerically identical to the default (fwd AND bwd)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
     x_proj = jnp.asarray(rng.normal(size=(4, 150, 64)).astype(np.float32))
     w_hh_t = jnp.asarray(rng.normal(size=(16, 64)).astype(np.float32))
 
@@ -106,9 +102,7 @@ def test_row_tile_env_override_parity(rng, monkeypatch):
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
     monkeypatch.setenv("MT_LSTM_ROW_TILE", "31")
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="multiple of 8"):
+    with pytest.raises(ValueError, match="multiple of 8"):
         lstm_recurrence(x_proj, w_hh_t, impl="interpret").block_until_ready()
 
 
