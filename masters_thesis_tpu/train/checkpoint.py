@@ -44,27 +44,117 @@ def save_checkpoint(
     ckpt_dir = Path(ckpt_dir).resolve()
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     path = ckpt_dir / tag
-    if path.exists():
-        shutil.rmtree(path)
+    # Crash-safe replacement protocol (the old code rmtree'd the live
+    # <tag> before the new write was durable — a SIGKILL mid-save then
+    # destroyed the only resume point, caught by the CLI kill-test):
+    #   1. orbax tree  -> <tag>.new        (complete before anything moves)
+    #   2. sidecar     -> <tag>.json.new   (meta matching the staged tree)
+    #   3. publish, renames only:  <tag> -> <tag>.old,  <tag>.new -> <tag>,
+    #      <tag>.json.new -> <tag>.json,  then best-effort rm <tag>.old
+    # A kill at ANY point leaves either the previous checkpoint intact or
+    # a staged pair that _recover_staged finishes on the next restore;
+    # the sidecar rides the same swap so tree and meta can never pair up
+    # across different saves. Publish steps run on process 0 only
+    # (multi-host checkpointing assumes shared storage, as orbax does).
+    staging = ckpt_dir / f"{tag}.new"
+    staged_sidecar = ckpt_dir / f"{tag}.json.new"
+    if jax.process_index() == 0:
+        # Sidecar BEFORE tree: a kill in between leaves an orphan tree
+        # (safely dropped by recovery), never an orphan sidecar that could
+        # later pair with a mismatched tree.
+        staged_sidecar.unlink(missing_ok=True)
+        if staging.exists():
+            shutil.rmtree(staging)
     with ocp.StandardCheckpointer() as ckptr:
         # to_state_dict turns optax namedtuple states into pure dicts, so the
         # restore side can rebuild any optimizer structure via from_state_dict
         # without orbax needing the live pytree as a template.
         ckptr.save(
-            path,
+            staging,
             {
                 "params": params,
                 "opt_state": fser.to_state_dict(jax.device_get(opt_state)),
             },
         )
         ckptr.wait_until_finished()
-    sidecar = {"spec": dataclasses.asdict(spec), "meta": meta}
     if jax.process_index() == 0:
-        # Atomic publish: a crash mid-write must not leave a torn sidecar
-        # (the auto-resume path reads it on restart).
-        atomic_write_text(
-            ckpt_dir / f"{tag}.json", json.dumps(sidecar, indent=2)
+        sidecar = {"spec": dataclasses.asdict(spec), "meta": meta}
+        atomic_write_text(staged_sidecar, json.dumps(sidecar, indent=2))
+        _publish(ckpt_dir, tag)
+
+
+def _publish(ckpt_dir: Path, tag: str) -> None:
+    """Swap a complete staged pair into place. Renames only (atomic); the
+    old tree is moved aside first and deleted last, best-effort. Shared by
+    save_checkpoint and crash recovery so the ordering can't diverge."""
+    path = ckpt_dir / tag
+    old = ckpt_dir / f"{tag}.old"
+    if old.exists():
+        shutil.rmtree(old)
+    if path.exists():
+        path.rename(old)
+    (ckpt_dir / f"{tag}.new").rename(path)
+    (ckpt_dir / f"{tag}.json.new").replace(ckpt_dir / f"{tag}.json")
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def _recover_staged(ckpt_dir: Path, tag: str) -> None:
+    """Finish (or discard) an interrupted save_checkpoint publish.
+
+    Covers every kill point of the staged-swap protocol (see
+    save_checkpoint): a finalized staging PAIR (tree + sidecar) supersedes
+    whatever is in place and is swapped in; a staged tree without its
+    sidecar predates publish — the previous checkpoint is still current,
+    so the orphan is dropped; a staged sidecar alone means the tree swap
+    finished and only the sidecar rename was lost. Orbax only ever exposes
+    a finalized tree under the staging name (its own writes go through a
+    tmp suffix), so ``staging.exists()`` implies the tree is complete.
+    """
+    path = ckpt_dir / tag
+    old = ckpt_dir / f"{tag}.old"
+    staging = ckpt_dir / f"{tag}.new"
+    staged_sidecar = ckpt_dir / f"{tag}.json.new"
+    if staging.exists():
+        if staged_sidecar.exists():
+            _publish(ckpt_dir, tag)
+        else:
+            shutil.rmtree(staging)
+    elif staged_sidecar.exists():
+        staged_sidecar.replace(ckpt_dir / f"{tag}.json")
+    if old.exists() and path.exists():
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def _run_recovery(ckpt_dir: Path, tag: str) -> None:
+    """Process-0 performs recovery; other processes WAIT for the staging
+    artifacts to disappear (shared checkpoint storage, as orbax assumes).
+
+    The wait triggers whenever artifacts are visible — even if a
+    restorable-looking pair already exists — because a (new tree, stale
+    sidecar) layout mid-recovery would otherwise let a non-zero process
+    read an epoch that disagrees with process 0's, desyncing the
+    multi-host resume decision and hanging the collectives.
+    """
+    staging = ckpt_dir / f"{tag}.new"
+    staged_sidecar = ckpt_dir / f"{tag}.json.new"
+    if jax.process_index() == 0:
+        _recover_staged(ckpt_dir, tag)
+    elif staging.exists() or staged_sidecar.exists():
+        from masters_thesis_tpu.utils import wait_until
+
+        wait_until(
+            lambda: not staging.exists() and not staged_sidecar.exists(),
+            60.0,
         )
+
+
+def checkpoint_restorable(ckpt_dir: Path, tag: str) -> bool:
+    """True if ``<ckpt_dir>/<tag>`` (tree + sidecar) can be restored,
+    after finishing any interrupted staging swap."""
+    ckpt_dir = Path(ckpt_dir)
+    if ckpt_dir.exists():
+        _run_recovery(ckpt_dir, tag)
+    return (ckpt_dir / tag).exists() and (ckpt_dir / f"{tag}.json").exists()
 
 
 def restore_checkpoint(
@@ -77,6 +167,13 @@ def restore_checkpoint(
     checkpoint file path on the CLI (reference: test.py:153,177).
     """
     ckpt_dir = Path(ckpt_dir).resolve()
+    # Recovery must look where the staging artifacts actually live: next
+    # to <tag> under a checkpoint ROOT, or next to the direct path itself
+    # (a direct path may not even exist yet if the kill landed mid-swap).
+    if (ckpt_dir / tag).exists() or (ckpt_dir / f"{tag}.new").exists():
+        _run_recovery(ckpt_dir, tag)
+    elif ckpt_dir.parent.exists():
+        _run_recovery(ckpt_dir.parent, ckpt_dir.name)
     if (ckpt_dir / tag).exists():
         path = ckpt_dir / tag
         sidecar_path = ckpt_dir / f"{tag}.json"

@@ -207,14 +207,15 @@ class Trainer:
         # and epoch counter (the reference's only resume affordance is
         # Lightning's save_last=True, train.py:159; restart semantics there
         # require manually passing ckpt_path).
-        # Both the orbax tree AND the sidecar must exist: a crash mid-save
-        # can leave one without the other (the sidecar is written after the
-        # orbax commit); in that case train from scratch rather than die.
+        # checkpoint_restorable also finishes an interrupted staged swap
+        # (kill between publish steps), so a crash at ANY point of a save
+        # leaves either the previous or the new checkpoint restorable;
+        # only a truly torn state (e.g. pre-staging layouts) falls back to
+        # training from scratch rather than dying.
         if (
             self.resume
             and self.ckpt_dir
-            and (self.ckpt_dir / "last").exists()
-            and (self.ckpt_dir / "last.json").exists()
+            and ckpt_lib.checkpoint_restorable(self.ckpt_dir, "last")
         ):
             from masters_thesis_tpu.train.checkpoint import (
                 restore_checkpoint,

@@ -96,6 +96,83 @@ def test_eval_cli_without_checkpoint_exits_cleanly(cli_run, capsys):
     assert "No model checkpoint found" in capsys.readouterr().err
 
 
+def test_sigkill_mid_training_then_cli_resume(tmp_path):
+    """Elastic recovery, for real: SIGKILL a training PROCESS mid-run, then
+    re-invoke the same CLI command with trainer.resume=true and finish.
+    (The in-process resume tests simulate the crash; this one doesn't.)"""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # hermetic from the TPU relay
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(_REPO_ROOT)
+    base = [
+        sys.executable, str(_REPO_ROOT / "train.py"),
+        "trainer=fast",
+        "trainer.enable_progress_bar=false",
+        "trainer.enable_model_summary=false",
+        "trainer.resume=true",
+        "model.hidden_size=8",
+        "model.num_layers=1",
+        "datamodule.n_samples=20000",
+        "datamodule.n_stocks=6",
+        f"datamodule.data_dir={tmp_path}/data",
+        f"logger.save_dir={tmp_path}/logs",
+        "logger.version=crashy",
+    ]
+    last_json = (
+        tmp_path / "logs" / "FinancialLstm" / "synthetic" / "crashy"
+        / "checkpoints" / "last.json"
+    )
+    # Run 1: enough epochs that it cannot finish before we kill it.
+    p = subprocess.Popen(
+        base + ["trainer.max_epochs=500"], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + 300
+    try:
+        while not last_json.exists():
+            assert p.poll() is None, "run finished before a checkpoint?!"
+            assert time.time() < deadline, "no checkpoint within 300s"
+            time.sleep(0.5)
+    finally:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=60)
+
+    import json
+
+    # Recover any save interrupted by the kill BEFORE reading the epoch:
+    # last.json can be one epoch stale if the SIGKILL landed inside the
+    # publish window (a staged pair awaiting its swap).
+    from masters_thesis_tpu.train.checkpoint import checkpoint_restorable
+
+    assert checkpoint_restorable(last_json.parent, "last")
+    crashed_epoch = json.loads(last_json.read_text())["meta"]["epoch"]
+    # Run 2: resume and run a couple more epochs to completion. The
+    # progress bar goes back on so the "resuming from" line is observable
+    # (a from-scratch run would also end at max_epochs-1, so the epoch
+    # assert alone can't distinguish resume from restart).
+    done = subprocess.run(
+        base + [
+            f"trainer.max_epochs={crashed_epoch + 3}",
+            "trainer.enable_progress_bar=true",
+        ],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert done.returncode == 0, done.stderr[-1500:]
+    assert "resuming from" in done.stdout
+    # Resumed at the right epoch: the first epoch it trains is crashed+1.
+    assert f"epoch {crashed_epoch + 1:4d}" in done.stdout
+    final = json.loads(last_json.read_text())
+    # trained the remaining epochs
+    assert final["meta"]["epoch"] == crashed_epoch + 2
+
+
 def test_warmup_checkpoint_keeps_config_objective(tmp_path):
     """checkpoint_mode=params must fine-tune under the CONFIG's objective,
     not the pretrain checkpoint's: the thesis warmup protocol fine-tunes a

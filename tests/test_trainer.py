@@ -345,6 +345,91 @@ class TestEmptyValSplit:
             np.testing.assert_allclose(a, b, rtol=1e-6)
 
 
+class TestCrashSafeCheckpointPublish:
+    """Every kill point of the staged checkpoint swap must leave a
+    restorable checkpoint (see checkpoint.save_checkpoint's protocol)."""
+
+    def _save(self, d, epoch):
+        from masters_thesis_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            d, "last", {"w": np.full((2,), float(epoch))}, {},
+            small_spec(), meta={"epoch": epoch},
+        )
+
+    def _restore_epoch(self, d):
+        from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+
+        params, _, _, meta = restore_checkpoint(d, "last")
+        assert float(params["w"][0]) == float(meta["epoch"])  # pair intact
+        return meta["epoch"]
+
+    def test_staged_pair_supersedes(self, tmp_path):
+        """Kill between staging and publish: the complete staged pair wins."""
+        import shutil
+
+        from masters_thesis_tpu.train.checkpoint import checkpoint_restorable
+
+        a, b = tmp_path / "a", tmp_path / "b"
+        self._save(a, 0)
+        self._save(b, 1)
+        shutil.move(str(b / "last"), str(a / "last.new"))
+        shutil.move(str(b / "last.json"), str(a / "last.json.new"))
+        assert checkpoint_restorable(a, "last")
+        assert self._restore_epoch(a) == 1
+        assert not (a / "last.new").exists()
+        assert not (a / "last.json.new").exists()
+
+    def test_orphan_staged_tree_dropped(self, tmp_path):
+        """Kill before the staged sidecar exists: previous checkpoint stays
+        current and the orphan tree is discarded."""
+        import shutil
+
+        from masters_thesis_tpu.train.checkpoint import checkpoint_restorable
+
+        a, b = tmp_path / "a", tmp_path / "b"
+        self._save(a, 0)
+        self._save(b, 1)
+        shutil.move(str(b / "last"), str(a / "last.new"))
+        assert checkpoint_restorable(a, "last")
+        assert self._restore_epoch(a) == 0
+        assert not (a / "last.new").exists()
+
+    def test_sidecar_swap_finished_on_recovery(self, tmp_path):
+        """Kill between the tree swap and the sidecar rename: recovery
+        finishes the sidecar so tree and meta pair up again."""
+        from masters_thesis_tpu.train.checkpoint import checkpoint_restorable
+
+        a = tmp_path / "a"
+        self._save(a, 0)
+        stale = (a / "last.json").read_text()
+        self._save(a, 1)
+        # Fabricate the kill: tree is epoch 1, sidecar rolled back to epoch
+        # 0, epoch-1 sidecar still staged.
+        (a / "last.json.new").write_text((a / "last.json").read_text())
+        (a / "last.json").write_text(stale)
+        assert checkpoint_restorable(a, "last")
+        assert self._restore_epoch(a) == 1
+
+    def test_mid_tree_swap_recovered(self, tmp_path):
+        """Kill between moving the old tree aside and renaming the staged
+        one in: <tag> is missing entirely, yet recovery restores the new
+        checkpoint."""
+        import shutil
+
+        from masters_thesis_tpu.train.checkpoint import checkpoint_restorable
+
+        a, b = tmp_path / "a", tmp_path / "b"
+        self._save(a, 0)
+        self._save(b, 1)
+        shutil.move(str(b / "last"), str(a / "last.new"))
+        shutil.move(str(b / "last.json"), str(a / "last.json.new"))
+        (a / "last").rename(a / "last.old")  # old moved aside, swap unfinished
+        assert checkpoint_restorable(a, "last")
+        assert self._restore_epoch(a) == 1
+        assert not (a / "last.old").exists()
+
+
 class TestPlateauScheduler:
     def test_reduces_after_patience(self):
         sched = PlateauScheduler(1e-3, factor=0.5, patience=2)
