@@ -116,6 +116,19 @@ class TestFit:
         b = r_stream.history[-1]["loss/total/train"]
         assert abs(a - b) / max(abs(a), abs(b)) < 0.5
 
+    def test_profile_writes_trace(self, tiny_dm, tmp_path):
+        """trainer.profile=true captures a jax.profiler trace of a
+        steady-state epoch into <log_dir>/profile (the reference has no
+        profiling at all, SURVEY.md §5 — only progress-bar flags)."""
+        from masters_thesis_tpu.train.logging import TensorBoardLogger
+
+        logger = TensorBoardLogger(tmp_path, "prof", "v0")
+        trainer = make_trainer(max_epochs=3, profile=True, logger=logger)
+        trainer.fit(small_spec(), tiny_dm)
+        logger.close()
+        traces = list((logger.log_dir / "profile").rglob("*.xplane.pb"))
+        assert traces, "no profiler trace written"
+
     def test_test_metrics(self, tiny_dm):
         trainer = make_trainer(max_epochs=1)
         result = trainer.fit(small_spec(), tiny_dm)

@@ -1,0 +1,67 @@
+"""Direct unit tests for the crash-safe IO primitives.
+
+These invariants underpin the dataset cache, checkpoint sidecars, and
+multi-process rendezvous (utils/io.py); until now they were only exercised
+indirectly through those subsystems.
+"""
+
+import pytest
+
+from masters_thesis_tpu.utils import atomic_publish, atomic_write_text, wait_until
+
+
+def _no_tmp_leftovers(directory):
+    return not [p for p in directory.iterdir() if ".tmp" in p.name]
+
+
+class TestAtomicPublish:
+    def test_clean_exit_publishes(self, tmp_path):
+        target = tmp_path / "artifact.json"
+        with atomic_publish(target) as tmp:
+            tmp.write_text("payload")
+            assert not target.exists()  # invisible until the rename
+        assert target.read_text() == "payload"
+        assert _no_tmp_leftovers(tmp_path)
+
+    def test_exception_leaves_target_untouched(self, tmp_path):
+        target = tmp_path / "artifact.json"
+        target.write_text("old")
+        with pytest.raises(RuntimeError):
+            with atomic_publish(target) as tmp:
+                tmp.write_text("half-written")
+                raise RuntimeError("writer died")
+        assert target.read_text() == "old"
+        assert _no_tmp_leftovers(tmp_path)
+
+    def test_overwrite_is_atomic_replace(self, tmp_path):
+        target = tmp_path / "artifact.json"
+        atomic_write_text(target, "v1")
+        atomic_write_text(target, "v2")
+        assert target.read_text() == "v2"
+        assert _no_tmp_leftovers(tmp_path)
+
+    def test_concurrent_writers_each_get_own_scratch(self, tmp_path):
+        target = tmp_path / "artifact.json"
+        with atomic_publish(target) as a, atomic_publish(target) as b:
+            assert a != b  # uuid scratch names: no cross-writer clobbering
+            a.write_text("A")
+            b.write_text("B")
+        # Context exit is LIFO: b renames first, a's rename lands LAST —
+        # the docstring's "last rename wins with an intact artifact".
+        assert target.read_text() == "A"
+        assert _no_tmp_leftovers(tmp_path)
+
+
+class TestWaitUntil:
+    def test_true_when_predicate_flips(self):
+        calls = {"n": 0}
+
+        def pred():
+            calls["n"] += 1
+            return calls["n"] >= 3
+
+        assert wait_until(pred, timeout_s=10.0, interval_s=0.01)
+        assert calls["n"] == 3
+
+    def test_false_on_timeout(self):
+        assert not wait_until(lambda: False, timeout_s=0.2, interval_s=0.05)
