@@ -259,6 +259,408 @@ def _bwd_pallas(interpret, residuals, dhs):
     return dx[:, :b], jnp.sum(dw_partial, axis=0)
 
 
+# ----------------------------------------------- fused layer-pair kernels
+#
+# A stacked LSTM's serial bottleneck is the chain of tiny recurrent matmuls:
+# L layers x T timesteps run back-to-back, so the reference workload
+# (2 layers, T=60) sits ~120 dependent MXU ops deep before overheads.  The
+# layers form a wavefront, though: layer 2 at step t-1 only needs layer 1's
+# h up to t-1, so one fused kernel can run layer 1 step t and layer 2 step
+# t-1 in the SAME loop iteration — two independent matmuls the MXU pipeline
+# can overlap — cutting the dependent chain from 2T to ~T+2.  The layer-2
+# input projection moves inside the kernel (it consumes h1, which never
+# leaves VMEM now), as does the inter-layer dropout, applied as a
+# precomputed mask.  Deeper stacks apply the fused kernel to consecutive
+# layer pairs, halving their chains.
+#
+# Single-program only: the pair's residual stash (x1_proj + mask + four
+# state planes) fits VMEM for the reference's ~100-row windows but not for
+# large batches; callers fall back to the per-layer path above when rows
+# exceed PAIR_MAX_ROWS (the backward aliases dx1 over x1_proj, same
+# hazard-free trick as the single-layer kernel, which is what keeps the
+# whole thing under the ~16 MB VMEM budget).
+
+PAIR_MAX_ROWS = 104
+
+
+def pair_rows_ok(b: int) -> bool:
+    """True when a b-row layer pair fits the single-program fused kernel."""
+    return -(-b // 8) * 8 <= PAIR_MAX_ROWS
+
+
+def pair_fusion_enabled() -> bool:
+    """Kill-switch for the fused layer-pair kernel (MT_LSTM_FUSED_PAIR=0).
+
+    Default ON: measured 1.14x (model=small) / 1.16x (model=medium)
+    train-step throughput on TPU v5e vs the per-layer kernels
+    (sweeps/bench_fused_pair.py, RESULTS.md).
+    """
+    return os.environ.get("MT_LSTM_FUSED_PAIR", "1") not in ("0", "")
+
+
+def _pair_fwd_kernel(
+    x1_ref, mask_ref, w1_ref, wi2_ref, b2_ref, w2_ref,
+    h2_out, h1_out, c1_out, c2_out,
+    h1_scr, c1_scr, h2_scr, c2_scr, x2_scr,
+):
+    n_t = x1_ref.shape[0]
+    h1_scr[:] = jnp.zeros_like(h1_scr)
+    c1_scr[:] = jnp.zeros_like(c1_scr)
+    h2_scr[:] = jnp.zeros_like(h2_scr)
+    c2_scr[:] = jnp.zeros_like(c2_scr)
+    w1 = w1_ref[:].astype(jnp.float32)
+    wi2 = wi2_ref[:].astype(jnp.float32)
+    b2 = b2_ref[:].astype(jnp.float32)
+    w2 = w2_ref[:].astype(jnp.float32)
+
+    def body(s, _):
+        # Layer 2, step s-1 — reads x2_scr (projection of h1[s-1]) BEFORE
+        # the layer-1 block below overwrites it with h1[s]'s projection.
+        @pl.when(s > 0)
+        def _l2():
+            t = s - 1
+            gates = x2_scr[:] + lax.dot_general(
+                h2_scr[:], w2, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            i, f, g, o = _gate_math(gates)
+            c = f * c2_scr[:] + i * g
+            h = o * jnp.tanh(c)
+            h2_scr[:] = h
+            c2_scr[:] = c
+            h2_out[t] = h.astype(h2_out.dtype)
+            c2_out[t] = c.astype(c2_out.dtype)
+
+        # Layer 1, step s (one step ahead of layer 2 — the wavefront).
+        @pl.when(s < n_t)
+        def _l1():
+            gates = x1_ref[s].astype(jnp.float32) + lax.dot_general(
+                h1_scr[:], w1, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            i, f, g, o = _gate_math(gates)
+            c = f * c1_scr[:] + i * g
+            h = o * jnp.tanh(c)
+            h1_scr[:] = h
+            c1_scr[:] = c
+            h1_out[s] = h.astype(h1_out.dtype)
+            c1_out[s] = c.astype(c1_out.dtype)
+            x2_scr[:] = b2 + lax.dot_general(
+                h * mask_ref[s].astype(jnp.float32), wi2,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        return 0
+
+    lax.fori_loop(0, n_t + 1, body, 0)
+
+
+def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
+    n_t, b, four_h = x1_proj.shape
+    hidden = four_h // 4
+    b_pad = -(-b // 8) * 8
+    if b_pad > PAIR_MAX_ROWS:
+        raise ValueError(
+            f"fused layer pair supports <= {PAIR_MAX_ROWS} rows, got {b}"
+        )
+    x1_padded = _pad_rows(x1_proj, b_pad)
+    mask_padded = _pad_rows(mask, b_pad)
+    b2_row = b2.reshape(1, four_h)
+
+    full_block = lambda width: pl.BlockSpec(  # noqa: E731
+        (n_t, b_pad, width), lambda: (0, 0, 0), memory_space=pltpu.VMEM
+    )
+    weight_block = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda: (0, 0), memory_space=pltpu.VMEM
+    )
+    h2s, h1s, c1s, c2s = pl.pallas_call(
+        _pair_fwd_kernel,
+        in_specs=[
+            full_block(four_h),
+            full_block(hidden),
+            weight_block((hidden, four_h)),
+            weight_block((hidden, four_h)),
+            weight_block((1, four_h)),
+            weight_block((hidden, four_h)),
+        ],
+        out_specs=[full_block(hidden)] * 4,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_t, b_pad, hidden), x1_proj.dtype)
+        ] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, four_h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x1_padded, mask_padded, w1t, wi2t, b2_row, w2t)
+    res = (
+        x1_padded, mask_padded, h1s, c1s, h2s, c2s, w1t, wi2t, b2_row, w2t, b
+    )
+    return h2s[:, :b], res
+
+
+def _pair_bwd_kernel(
+    dh2_ref, x1_ref, mask_ref, h1_ref, c1_ref, h2_ref, c2_ref,
+    w1_ref, wi2_ref, b2_ref, w2_ref,
+    dx1_out, dw1_out, dwi2_out, db2_out, dw2_out,
+    dh1_scr, dc1_scr, dh2_scr, dc2_scr,
+    dw1_scr, dwi2_scr, db2_scr, dw2_scr, dh1_in_scr,
+):
+    n_t = dh2_ref.shape[0]
+    for scr in (dh1_scr, dc1_scr, dh2_scr, dc2_scr,
+                dw1_scr, dwi2_scr, db2_scr, dw2_scr, dh1_in_scr):
+        scr[:] = jnp.zeros_like(scr)
+    w1 = w1_ref[:].astype(jnp.float32)
+    wi2 = wi2_ref[:].astype(jnp.float32)
+    b2 = b2_ref[:].astype(jnp.float32)
+    w2 = w2_ref[:].astype(jnp.float32)
+
+    def body(k, _):
+        # Layer 1 bwd at t = n_t - k, one step BEHIND layer 2's reverse
+        # sweep: it consumes dh1_in_scr written by the layer-2 block at
+        # iteration k-1, so it must run before that block overwrites it.
+        @pl.when(k > 0)
+        def _l1():
+            t = n_t - k
+            t_prev = jnp.maximum(t - 1, 0)
+            not_first = jnp.float32(1.0) - (t == 0).astype(jnp.float32)
+            c_prev = c1_ref[t_prev].astype(jnp.float32) * not_first
+            h_prev = h1_ref[t_prev].astype(jnp.float32) * not_first
+            gates = x1_ref[t].astype(jnp.float32) + lax.dot_general(
+                h_prev, w1, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            i, f, g, o = _gate_math(gates)
+            tanh_c = jnp.tanh(c1_ref[t].astype(jnp.float32))
+            dh = dh1_in_scr[:] + dh1_scr[:]
+            do = dh * tanh_c
+            dc = dh * o * (1.0 - tanh_c * tanh_c) + dc1_scr[:]
+            di = dc * g
+            dg = dc * i
+            df = dc * c_prev
+            dc1_scr[:] = dc * f
+            d_pre = jnp.concatenate(
+                [
+                    di * i * (1.0 - i),
+                    df * f * (1.0 - f),
+                    dg * (1.0 - g * g),
+                    do * o * (1.0 - o),
+                ],
+                axis=-1,
+            )
+            # Slot t of the aliased x1 buffer is dead from here on.
+            dx1_out[t] = d_pre.astype(dx1_out.dtype)
+            dh1_scr[:] = lax.dot_general(
+                d_pre, w1, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dw1_scr[:] += lax.dot_general(
+                h_prev, d_pre, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        # Layer 2 bwd at t = n_t - 1 - k.
+        @pl.when(k < n_t)
+        def _l2():
+            t = n_t - 1 - k
+            t_prev = jnp.maximum(t - 1, 0)
+            not_first = jnp.float32(1.0) - (t == 0).astype(jnp.float32)
+            c_prev = c2_ref[t_prev].astype(jnp.float32) * not_first
+            h_prev = h2_ref[t_prev].astype(jnp.float32) * not_first
+            mask_t = mask_ref[t].astype(jnp.float32)
+            h1m = h1_ref[t].astype(jnp.float32) * mask_t
+            # Recompute layer 2's input projection AND gates from VMEM
+            # stashes (cheaper than stashing the (T, B, 4H) projection).
+            x2 = b2 + lax.dot_general(
+                h1m, wi2, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            gates = x2 + lax.dot_general(
+                h_prev, w2, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            i, f, g, o = _gate_math(gates)
+            tanh_c = jnp.tanh(c2_ref[t].astype(jnp.float32))
+            dh = dh2_ref[t].astype(jnp.float32) + dh2_scr[:]
+            do = dh * tanh_c
+            dc = dh * o * (1.0 - tanh_c * tanh_c) + dc2_scr[:]
+            di = dc * g
+            dg = dc * i
+            df = dc * c_prev
+            dc2_scr[:] = dc * f
+            d_pre = jnp.concatenate(
+                [
+                    di * i * (1.0 - i),
+                    df * f * (1.0 - f),
+                    dg * (1.0 - g * g),
+                    do * o * (1.0 - o),
+                ],
+                axis=-1,
+            )
+            dh2_scr[:] = lax.dot_general(
+                d_pre, w2, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dw2_scr[:] += lax.dot_general(
+                h_prev, d_pre, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dwi2_scr[:] += lax.dot_general(
+                h1m, d_pre, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            db2_scr[:] += jnp.sum(d_pre, axis=0, keepdims=True)
+            dh1_in_scr[:] = mask_t * lax.dot_general(
+                d_pre, wi2, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        return 0
+
+    lax.fori_loop(0, n_t + 1, body, 0)
+    dw1_out[:] = dw1_scr[:].astype(dw1_out.dtype)
+    dwi2_out[:] = dwi2_scr[:].astype(dwi2_out.dtype)
+    db2_out[:] = db2_scr[:].astype(db2_out.dtype)
+    dw2_out[:] = dw2_scr[:].astype(dw2_out.dtype)
+
+
+def _pair_bwd_pallas(interpret, res, dh2s):
+    (x1_padded, mask_padded, h1s, c1s, h2s, c2s,
+     w1t, wi2t, b2_row, w2t, b) = res
+    n_t, b_pad, four_h = x1_padded.shape
+    hidden = four_h // 4
+    dh2s = _pad_rows(dh2s, b_pad)
+
+    full_block = lambda width: pl.BlockSpec(  # noqa: E731
+        (n_t, b_pad, width), lambda: (0, 0, 0), memory_space=pltpu.VMEM
+    )
+    weight_block = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda: (0, 0), memory_space=pltpu.VMEM
+    )
+    dx1, dw1t, dwi2t, db2_row, dw2t = pl.pallas_call(
+        _pair_bwd_kernel,
+        in_specs=[
+            full_block(hidden),    # dh2s
+            full_block(four_h),    # x1_proj (aliased to dx1)
+            full_block(hidden),    # mask
+            full_block(hidden),    # h1s
+            full_block(hidden),    # c1s
+            full_block(hidden),    # h2s
+            full_block(hidden),    # c2s
+            weight_block((hidden, four_h)),
+            weight_block((hidden, four_h)),
+            weight_block((1, four_h)),
+            weight_block((hidden, four_h)),
+        ],
+        out_specs=[
+            full_block(four_h),
+            weight_block((hidden, four_h)),
+            weight_block((hidden, four_h)),
+            weight_block((1, four_h)),
+            weight_block((hidden, four_h)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_t, b_pad, four_h), x1_padded.dtype),
+            jax.ShapeDtypeStruct((hidden, four_h), w1t.dtype),
+            jax.ShapeDtypeStruct((hidden, four_h), wi2t.dtype),
+            jax.ShapeDtypeStruct((1, four_h), b2_row.dtype),
+            jax.ShapeDtypeStruct((hidden, four_h), w2t.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+            pltpu.VMEM((hidden, four_h), jnp.float32),
+            pltpu.VMEM((hidden, four_h), jnp.float32),
+            pltpu.VMEM((1, four_h), jnp.float32),
+            pltpu.VMEM((hidden, four_h), jnp.float32),
+            pltpu.VMEM((b_pad, hidden), jnp.float32),
+        ],
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(dh2s, x1_padded, mask_padded, h1s, c1s, h2s, c2s,
+      w1t, wi2t, b2_row, w2t)
+    dmask = jnp.zeros_like(mask_padded[:, :b])  # dropout mask: nondiff
+    return (dx1[:, :b], dw1t, dwi2t, db2_row.reshape(four_h), dw2t, dmask)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _lstm_pair_pallas(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask,
+                      interpret=False):
+    h2s, _ = _pair_fwd_pallas(
+        x1_proj, mask, w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret=interpret
+    )
+    return h2s
+
+
+def _pair_vjp_fwd(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, interpret):
+    return _pair_fwd_pallas(
+        x1_proj, mask, w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret=interpret
+    )
+
+
+_lstm_pair_pallas.defvjp(_pair_vjp_fwd, _pair_bwd_pallas)
+
+
+def lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask):
+    """Reference formulation of the fused pair: two scans + projection."""
+    h1s = lstm_recurrence_xla(x1_proj, w_hh1_t)
+    x2_proj = (h1s * mask) @ w_ih2_t + bias2
+    return lstm_recurrence_xla(x2_proj, w_hh2_t)
+
+
+def lstm_pair_recurrence(
+    x1_proj: jax.Array,
+    w_hh1_t: jax.Array,
+    w_ih2_t: jax.Array,
+    bias2: jax.Array,
+    w_hh2_t: jax.Array,
+    mask: jax.Array,
+    impl: str = "auto",
+) -> jax.Array:
+    """Run TWO stacked LSTM layers as one fused wavefront recurrence.
+
+    Args:
+        x1_proj: ``(T, B, 4H)`` time-major layer-1 input projections
+            (``x @ w_ihᵀ`` plus both biases), gate order i, f, g, o.
+        w_hh1_t: ``(H, 4H)`` transposed layer-1 recurrent weight.
+        w_ih2_t: ``(H, 4H)`` transposed layer-2 input weight.
+        bias2: ``(4H,)`` layer-2 combined bias (``b_ih + b_hh``).
+        w_hh2_t: ``(H, 4H)`` transposed layer-2 recurrent weight.
+        mask: ``(T, B, H)`` inter-layer dropout mask (already scaled by
+            ``1/(1-p)``; all-ones when deterministic), applied to layer-1
+            outputs before the layer-2 projection.
+        impl: ``"pallas"`` | ``"xla"`` | ``"interpret"`` | ``"auto"``.
+
+    Returns:
+        ``(T, B, H)`` layer-2 hidden states for every timestep.
+    """
+    if impl == "auto":
+        impl = (
+            "xla"
+            if os.environ.get("MT_TPU_DISABLE_PALLAS")
+            else ("pallas" if jax.default_backend() == "tpu" else "xla")
+        )
+    if impl in ("pallas", "interpret") and not pair_rows_ok(x1_proj.shape[1]):
+        impl = "xla"  # residual stash would not fit one VMEM program
+    if impl == "pallas":
+        return _lstm_pair_pallas(
+            x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, False
+        )
+    if impl == "interpret":
+        return _lstm_pair_pallas(
+            x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, True
+        )
+    if impl == "xla":
+        return lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask)
+    raise ValueError(f"unknown lstm impl: {impl!r}")
+
+
 # -------------------------------------------------------------- public API
 
 

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""A/B micro-bench: fused layer-pair Pallas kernel vs the per-layer path.
+
+Measures canonical-workload train-step throughput (100-stock windows,
+batch_size=1, model=small -> 2 layers, and model=medium -> 4 layers) with
+MT_LSTM_FUSED_PAIR=0 and =1. Each point runs in a subprocess so the env
+switch cannot leak across jit traces.
+
+Usage: python sweeps/bench_fused_pair.py            # orchestrate A/B
+       python sweeps/bench_fused_pair.py --child 1 small   # one point
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+MODEL_LAYERS = {"small": 2, "medium": 4}
+
+
+def child(fused: str, model: str) -> None:
+    os.environ["MT_LSTM_FUSED_PAIR"] = fused
+    sys.path.insert(0, str(REPO))
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.train import Trainer
+
+    data_dir = REPO / "data" / "bench_synthetic"
+    bootstrap_synthetic(data_dir, n_stocks=100, n_samples=100_000, seed=0)
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=60, target_window=30, stride=90,
+        batch_size=1,
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    spec = ModelSpec(
+        objective="mse",
+        num_layers=MODEL_LAYERS[model],
+        dropout=0.2 if model == "small" else 0.3,
+    )
+    trainer = Trainer(
+        max_epochs=7,  # epoch 0 absorbs compile
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=10_000,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+    result = trainer.fit(spec, dm)
+    print(json.dumps({
+        "fused": fused, "model": model,
+        "steps_per_sec": round(result.steps_per_sec, 2),
+    }))
+
+
+def main() -> None:
+    rows = []
+    for model in MODEL_LAYERS:
+        for fused in ("0", "1"):
+            t0 = time.time()
+            out = subprocess.run(
+                [sys.executable, __file__, "--child", fused, model],
+                cwd=REPO, timeout=900, capture_output=True, text=True,
+            )
+            if out.returncode != 0:
+                print(f"[{model} fused={fused}] FAILED:\n{out.stderr[-2000:]}")
+                continue
+            row = json.loads(out.stdout.strip().splitlines()[-1])
+            row["wall_s"] = round(time.time() - t0, 1)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    by = {(r["model"], r["fused"]): r["steps_per_sec"] for r in rows}
+    for model in MODEL_LAYERS:
+        a, b = by.get((model, "0")), by.get((model, "1"))
+        if a and b:
+            print(f"{model}: unfused {a} -> fused {b} steps/s "
+                  f"({b / a:.2f}x)")
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        child(sys.argv[i + 1], sys.argv[i + 2])
+    else:
+        main()

@@ -70,6 +70,13 @@ def tpu_ready() -> bool:
 
 def wait_for_tpu(deadline: float) -> bool:
     while time.time() < deadline - 300:
+        # Cooperative pause: `touch results/PAUSE` stops the runner from
+        # LAUNCHING new cells (the in-flight cell finishes), freeing the
+        # chip for interactive measurements; `rm` it to resume the grid.
+        if (RESULTS_DIR / "PAUSE").exists():
+            log("paused via results/PAUSE; checking again in 15s")
+            time.sleep(15)
+            continue
         if tpu_ready():
             return True
         log("TPU relay not ready; retrying in 60s")

@@ -12,8 +12,11 @@ import pytest
 
 from masters_thesis_tpu.ops.lstm_kernel import (
     ROW_TILE,
+    lstm_pair_recurrence,
+    lstm_pair_xla,
     lstm_recurrence,
     lstm_recurrence_xla,
+    pair_rows_ok,
 )
 
 
@@ -104,6 +107,138 @@ def test_row_tile_env_override_parity(rng, monkeypatch):
     monkeypatch.setenv("MT_LSTM_ROW_TILE", "31")
     with pytest.raises(ValueError, match="multiple of 8"):
         lstm_recurrence(x_proj, w_hh_t, impl="interpret").block_until_ready()
+
+
+def _random_pair_case(rng, n_t, b, hidden, *, dropout=0.0):
+    x1 = jnp.asarray(rng.normal(size=(n_t, b, 4 * hidden)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
+    wi2 = jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
+    b2 = jnp.asarray(rng.normal(size=(4 * hidden,)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
+    if dropout:
+        keep = rng.random(size=(n_t, b, hidden)) > dropout
+        mask = jnp.asarray(keep / (1.0 - dropout), jnp.float32)
+    else:
+        mask = jnp.ones((n_t, b, hidden), jnp.float32)
+    return x1, w1, wi2, b2, w2, mask
+
+
+@pytest.mark.parametrize(
+    "n_t,b,hidden,dropout",
+    [
+        (5, 4, 8, 0.0),       # tiny
+        (5, 4, 8, 0.3),       # with a dropout mask in the seam
+        (3, 13, 8, 0.0),      # row remainder -> padding path
+        (60, 100, 64, 0.2),   # the reference workload shape (model=small)
+    ],
+)
+def test_pair_forward_parity(rng, n_t, b, hidden, dropout):
+    args = _random_pair_case(rng, n_t, b, hidden, dropout=dropout)
+    ref = lstm_pair_xla(*args)
+    out = lstm_pair_recurrence(*args, impl="interpret")
+    assert out.shape == (n_t, b, hidden)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "n_t,b,hidden,dropout",
+    [(5, 4, 8, 0.0), (6, 13, 16, 0.3), (12, 40, 16, 0.2)],
+)
+def test_pair_gradient_parity(rng, n_t, b, hidden, dropout):
+    args = _random_pair_case(rng, n_t, b, hidden, dropout=dropout)
+    w_out = jnp.asarray(rng.normal(size=(n_t, b, hidden)), jnp.float32)
+
+    def loss(fn):
+        def inner(x1, w1, wi2, b2, w2):
+            return jnp.sum(fn(x1, w1, wi2, b2, w2, args[5]) * w_out)
+
+        return inner
+
+    ref_fn = loss(lstm_pair_xla)
+    pl_fn = loss(
+        lambda *a: lstm_pair_recurrence(*a, impl="interpret")
+    )
+    grads_ref = jax.grad(ref_fn, argnums=(0, 1, 2, 3, 4))(*args[:5])
+    grads_pl = jax.grad(pl_fn, argnums=(0, 1, 2, 3, 4))(*args[:5])
+    names = ("dx1", "dw_hh1", "dw_ih2", "db2", "dw_hh2")
+    for name, g_pl, g_ref in zip(names, grads_pl, grads_ref):
+        np.testing.assert_allclose(
+            np.asarray(g_pl),
+            np.asarray(g_ref),
+            atol=2e-4 * max(1, b // 16),
+            err_msg=name,
+        )
+
+
+def test_pair_rows_guard():
+    assert pair_rows_ok(100)
+    assert pair_rows_ok(104)
+    assert not pair_rows_ok(105)
+    assert not pair_rows_ok(800)
+
+
+def test_pair_large_rows_falls_back_to_xla(rng):
+    """Above the VMEM row bound the pair API silently uses the scan path."""
+    args = _random_pair_case(rng, 3, 120, 8)
+    out = lstm_pair_recurrence(*args, impl="interpret")
+    ref = lstm_pair_xla(*args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_encoder_fused_pair_matches_unfused(rng, monkeypatch):
+    """Full encoder, deterministic mode: fused-pair and per-layer paths
+    must agree for every depth (2 = one pair, 3 = pair + tail, 4 = two
+    pairs)."""
+    from masters_thesis_tpu.models.lstm import LstmEncoder
+
+    x = jnp.asarray(rng.normal(size=(9, 12, 3)), jnp.float32)
+    for layers in (2, 3, 4):
+        enc = LstmEncoder(hidden_size=16, num_layers=layers)
+        monkeypatch.delenv("MT_LSTM_FUSED_PAIR", raising=False)
+        params = enc.init(jax.random.key(0), x)["params"]
+        a_ref, b_ref = LstmEncoder(
+            hidden_size=16, num_layers=layers, kernel_impl="xla"
+        ).apply({"params": params}, x)
+        monkeypatch.setenv("MT_LSTM_FUSED_PAIR", "1")
+        a_fused, b_fused = LstmEncoder(
+            hidden_size=16, num_layers=layers, kernel_impl="interpret"
+        ).apply({"params": params}, x)
+        np.testing.assert_allclose(
+            np.asarray(a_fused), np.asarray(a_ref), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(b_fused), np.asarray(b_ref), atol=1e-5
+        )
+
+
+def test_encoder_fused_pair_gradients(rng, monkeypatch):
+    """Fused-path encoder gradients match the per-layer path (no dropout)."""
+    from masters_thesis_tpu.models.lstm import LstmEncoder
+
+    x = jnp.asarray(rng.normal(size=(7, 10, 3)), jnp.float32)
+    enc_ref = LstmEncoder(hidden_size=16, num_layers=2, kernel_impl="xla")
+    params = enc_ref.init(jax.random.key(1), x)["params"]
+
+    def loss(encoder, p):
+        a, b = encoder.apply({"params": p}, x)
+        return jnp.sum(a**2) + jnp.sum(jnp.abs(b))
+
+    monkeypatch.delenv("MT_LSTM_FUSED_PAIR", raising=False)
+    g_ref = jax.grad(lambda p: loss(enc_ref, p))(params)
+    monkeypatch.setenv("MT_LSTM_FUSED_PAIR", "1")
+    enc_fused = LstmEncoder(
+        hidden_size=16, num_layers=2, kernel_impl="interpret"
+    )
+    g_fused = jax.grad(lambda p: loss(enc_fused, p))(params)
+    flat_ref = jax.tree.leaves_with_path(g_ref)
+    flat_fused = jax.tree.flatten(g_fused)[0]
+    for (path, leaf_ref), leaf_fused in zip(flat_ref, flat_fused):
+        np.testing.assert_allclose(
+            np.asarray(leaf_fused),
+            np.asarray(leaf_ref),
+            atol=5e-5,
+            err_msg=str(path),
+        )
 
 
 def test_auto_falls_back_to_xla_on_cpu(rng):
