@@ -215,6 +215,12 @@ def _run_scaling_subprocess() -> dict | None:
         return None
 
 
+def _fused_pair_enabled() -> bool:
+    from masters_thesis_tpu.ops.lstm_kernel import pair_fusion_enabled
+
+    return pair_fusion_enabled()
+
+
 def main() -> None:
     degraded, probe_attempts = _ensure_responsive_backend()
     # CPU fallback is ~300x slower per step: trim the measurement window so
@@ -272,6 +278,10 @@ def main() -> None:
             "wall_s": round(wall, 1),
             "device": jax.devices()[0].platform,
             "probe_attempts": probe_attempts,
+            # Whether pair fusion was ENABLED (env kill-switch); the Pallas
+            # pair kernel additionally requires a TPU backend and <=104
+            # rows — on the degraded CPU path it lowers to the scan form.
+            "fused_pair_enabled": _fused_pair_enabled(),
             "nll_steps_per_sec": (
                 None if nll_sps is None else round(nll_sps, 2)
             ),

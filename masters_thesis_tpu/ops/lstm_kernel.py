@@ -31,6 +31,11 @@ recurrent matmul plus gate math — is fused into a single Pallas kernel:
 Everything is time-major ``(T, B, ...)``: each timestep slice is then a
 contiguous ``(rows, lanes)`` tile, matching the TPU's (8, 128) layout.
 
+Stacked layers additionally fuse in PAIRS into a single wavefront program
+(``lstm_pair_recurrence`` below) that runs layer l step t alongside layer
+l+1 step t-1, halving the serial matmul chain — see the fused layer-pair
+section for the scheduling and VMEM-budget analysis.
+
 On non-TPU backends ``lstm_recurrence`` falls back to an identical
 ``lax.scan`` formulation; tests additionally run the Pallas kernels in
 interpreter mode on CPU to pin parity between the two paths.

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Measured perf points for RESULTS.md's headroom items.
+
+Two measurements, each point in its own subprocess (env/trace isolation):
+
+1. bf16 recurrence: canonical bs=1 workload at precision=bf16-mixed vs the
+   32-true default (headroom item 2 — does halving MXU cycles help a
+   latency-bound chain?).
+2. Tiled-fallback row block: bs=8/32 windows/s at MT_LSTM_ROW_TILE in
+   {32, 64, 96} (headroom item 1 — larger (tile, H) recurrent matmuls vs
+   VMEM pressure in the grid-pipelined per-layer kernels).
+
+Usage: python sweeps/bench_points.py          # orchestrate all points
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def child(batch_size: int, precision: str, row_tile: str) -> None:
+    if row_tile:
+        os.environ["MT_LSTM_ROW_TILE"] = row_tile
+    sys.path.insert(0, str(REPO))
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.train import Trainer
+
+    data_dir = REPO / "data" / "bench_synthetic"
+    bootstrap_synthetic(data_dir, n_stocks=100, n_samples=100_000, seed=0)
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=60, target_window=30, stride=90,
+        batch_size=batch_size,
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    trainer = Trainer(
+        max_epochs=5,  # epoch 0 absorbs compile
+        gradient_clip_val=5.0,
+        precision=precision,
+        check_val_every_n_epoch=10_000,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+    result = trainer.fit(ModelSpec(objective="mse"), dm)
+    print(json.dumps({
+        "batch_size": batch_size, "precision": precision,
+        "row_tile": row_tile or "default",
+        "steps_per_sec": round(result.steps_per_sec, 2),
+        "windows_per_sec": round(result.steps_per_sec * batch_size, 2),
+    }))
+
+
+def run_point(batch_size: int, precision: str, row_tile: str) -> dict | None:
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, __file__, "--child",
+         str(batch_size), precision, row_tile],
+        cwd=REPO, timeout=900, capture_output=True, text=True,
+    )
+    if out.returncode != 0:
+        print(f"[bs={batch_size} {precision} tile={row_tile}] FAILED:\n"
+              f"{out.stderr[-1500:]}")
+        return None
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    row["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> None:
+    rows = []
+    # bf16 vs f32 at the canonical parity point.
+    for precision in ("32-true", "bf16-mixed"):
+        rows.append(run_point(1, precision, ""))
+    # Row-tile sweep in the tiled-fallback regime.
+    for bs, tile in itertools.product((8, 32), ("32", "64", "96")):
+        rows.append(run_point(bs, "32-true", tile))
+    print(json.dumps([r for r in rows if r], indent=2))
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        child(int(sys.argv[i + 1]), sys.argv[i + 2], sys.argv[i + 3])
+    else:
+        main()

@@ -7,7 +7,12 @@ rendezvous, and the cross-process shard_map train/eval path for real
 (everything the reference's latent DDP story would do over NCCL,
 reference: train.py:169-180, src/model.py:24-25).
 
-Usage: python tests/_distributed_worker.py <coord_addr> <rank> <world> <workdir>
+Usage: python tests/_distributed_worker.py <coord_addr> <rank> <world> \
+           <workdir> [devices_per_process]
+
+With devices_per_process > 1 the 2-process world forms a (world x local)
+global mesh — the multi-host pod topology (DCN between processes, ICI
+within a host) rather than one chip per host.
 """
 
 from __future__ import annotations
@@ -24,6 +29,7 @@ def main() -> None:
         int(sys.argv[3]),
         Path(sys.argv[4]),
     )
+    local = int(sys.argv[5]) if len(sys.argv) > 5 else 1
     from masters_thesis_tpu.parallel import distributed_initialize
 
     distributed_initialize(
@@ -35,7 +41,8 @@ def main() -> None:
     import jax
 
     assert jax.process_count() == world, jax.process_count()
-    assert len(jax.devices()) == world  # one CPU device per process
+    assert len(jax.local_devices()) == local
+    assert len(jax.devices()) == world * local
 
     import numpy as np
 
@@ -71,7 +78,7 @@ def main() -> None:
         enable_model_summary=False,
         seed=0,
     )
-    assert trainer.n_dev == world
+    assert trainer.n_dev == world * local
     spec = ModelSpec(
         objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
         learning_rate=1e-2,
@@ -105,6 +112,7 @@ def main() -> None:
                 "stream_history": stream.history,
                 "process_count": jax.process_count(),
                 "n_dev": trainer.n_dev,
+                "local_devices": len(jax.local_devices()),
             }
         )
     )

@@ -250,15 +250,17 @@ class TestEpochScaleLossCurveParity:
         import copy
 
         tmodel, params = _torch_model_and_params(dropout=0.2)
-        replicas = [copy.deepcopy(tmodel) for _ in range(2)]
+        replicas = [copy.deepcopy(tmodel) for _ in range(3)]
         tr, va = parity_dm.train_arrays(), parity_dm.val_arrays()
         t_hist = fit_reference(
             tmodel, tr, va, objective, epochs=PARITY_EPOCHS, lr=PARITY_LR,
             shuffle_seed=0,
         )
         # Same-framework noise envelope from independently-seeded torch
-        # replicas of the identical run (a 2-run estimate understates the
-        # max-deviation spread; 3 runs = 3 pairwise gaps).
+        # replicas of the identical run. An n-run max-pairwise-gap
+        # UNDERSTATES the spread a fresh sample can show (order
+        # statistics); 4 runs = 6 pairwise gaps tighten that estimate vs
+        # the 1.5x headroom below.
         t_replica_hists = []
         for i, m in enumerate(replicas):
             torch.manual_seed(100 + i)
@@ -279,14 +281,7 @@ class TestEpochScaleLossCurveParity:
                 for i, a in enumerate(torch_runs)
                 for b in torch_runs[i + 1:]
             )
-            # Cluster-membership statistic: the jax run's gap to its
-            # NEAREST torch neighbor. The envelope is a max of pairwise
-            # spreads, so each torch run itself only sits within the
-            # envelope of its nearest neighbor — demanding the jax run's
-            # MAX gap to every torch run stay under it is strictly harsher
-            # than the property the torch cluster satisfies (a 4th torch
-            # seed can fail that check by construction).
-            gap = min(_curve_gap(t, f_hist, key) for t in torch_runs)
+            gap = max(_curve_gap(t, f_hist, key) for t in torch_runs)
             assert gap <= max(1.5 * envelope, 0.01 + envelope), (
                 f"{key} curve gap {gap:.4f} exceeds RNG-noise envelope "
                 f"{envelope:.4f}"

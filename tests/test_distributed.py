@@ -36,19 +36,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_training(tmp_path):
+def _run_world(tmp_path, local_devices: int) -> list[dict]:
     coord = f"127.0.0.1:{_free_port()}"
     env = os.environ.copy()
-    # Hermetic from the TPU relay (see conftest.py) and exactly ONE CPU
-    # device per process so the 2-process world is a 2-device mesh.
+    # Hermetic from the TPU relay (see conftest.py); local_devices CPU
+    # devices per process.
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}"
+    )
     env["PYTHONPATH"] = str(_REPO_ROOT)
 
     procs = [
         subprocess.Popen(
-            [sys.executable, str(_WORKER), coord, str(rank), "2", str(tmp_path)],
+            [sys.executable, str(_WORKER), coord, str(rank), "2",
+             str(tmp_path), str(local_devices)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -65,9 +68,15 @@ def test_two_process_distributed_training(tmp_path):
     ]
     for m in meta:
         assert m["process_count"] == 2
-        assert m["n_dev"] == 2
+        assert m["local_devices"] == local_devices
+        assert m["n_dev"] == 2 * local_devices
         assert np.isfinite(m["best_val"])
         assert np.isfinite(m["test"]["mae"])
+    return meta
+
+
+def test_two_process_distributed_training(tmp_path):
+    meta = _run_world(tmp_path, local_devices=1)
     # Same program, same psum'd grads => identical history on every rank.
     assert meta[0]["history"] == meta[1]["history"]
     assert meta[0]["history"]  # non-empty
@@ -79,5 +88,21 @@ def test_two_process_distributed_training(tmp_path):
     a = np.load(tmp_path / "rank0.npz")
     b = np.load(tmp_path / "rank1.npz")
     assert a.files
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_two_process_multi_device_pod_topology(tmp_path):
+    """2 processes x 4 devices each = an 8-device global mesh — the real
+    multi-host pod shape (DCN between processes, intra-host devices within),
+    not one chip per host. Same DDP invariant: every rank sees identical
+    history and final params."""
+    meta = _run_world(tmp_path, local_devices=4)
+    assert meta[0]["history"] == meta[1]["history"]
+    assert meta[0]["history"]
+    assert meta[0]["stream_history"] == meta[1]["stream_history"]
+
+    a = np.load(tmp_path / "rank0.npz")
+    b = np.load(tmp_path / "rank1.npz")
     for k in a.files:
         np.testing.assert_array_equal(a[k], b[k])
