@@ -17,11 +17,13 @@ The single JSON line also carries (in "detail"):
 - ``batch_sweep``: windows/sec at batch_size 1/8/32 — where throughput
   saturates once the per-step dispatch floor is amortized (the tiny-batch
   regime is the known TPU hard part, SURVEY.md §7).
-- ``scaling``: 1-device vs 8-device scan-epoch throughput at FIXED global
-  batch on the virtual CPU mesh (run in a subprocess so the backend choice
-  doesn't leak into this process) — the strong-scaling methodology artifact
-  for the 1→8→32-chip north star; on virtual devices it measures program
-  structure (collective overhead, per-device dispatch), not real ICI.
+- ``scaling``: 1-device vs 8-device scan-epoch throughput on the virtual
+  CPU mesh (run in a subprocess so the backend choice doesn't leak into
+  this process) — strong scaling at fixed global batch (the honest
+  tiny-batch hard case) plus a same-total-work sharding-overhead ratio
+  (the transferable cost of partitioning + psum at the weak-scaling
+  program shape) — the methodology artifact for the 1→8→32-chip north
+  star; on virtual devices it measures program structure, not real ICI.
 
 vs_baseline: the reference publishes no throughput numbers (SURVEY.md §6).
 The denominator used here is 200 steps/sec/chip — a deliberately generous
@@ -172,14 +174,33 @@ def _scaling_child() -> None:
     sps_1 = run(1, global_batch)  # 1 device x 8 windows/step
     sps_8 = run(8, 1)  # 8 devices x 1 window/step, pmean over the mesh
     speedup = sps_8 / sps_1 if sps_1 > 0 else 0.0
+    # Sharding overhead at SAME TOTAL WORK: 1 device x 64-window steps vs
+    # 8 devices x 8 windows each (64 global). On a virtual mesh the
+    # devices share the host's cores, so true weak scaling is unmeasurable
+    # (bounded at 1/n by construction); holding total work fixed instead
+    # isolates what sharding the program costs — partitioning, psum
+    # collectives, per-device dispatch. Ideal ratio 1.0; on real chips
+    # (separate compute per device) this same program shape is the weak-
+    # scaling step, so the overhead measured here is the transferable part.
+    sps_1_big = run(1, 64)
+    sps_8_big = run(8, 8)  # 64 global, sharded 8 ways
+    overhead_ratio = sps_8_big / sps_1_big if sps_1_big > 0 else 0.0
     print(
         json.dumps(
             {
-                "global_batch": global_batch,
-                "steps_per_sec_1dev": round(sps_1, 2),
-                "steps_per_sec_8dev": round(sps_8, 2),
-                "speedup_8dev": round(speedup, 3),
-                "efficiency": round(speedup / 8.0, 3),
+                "strong_fixed_global_batch": {
+                    "global_batch": global_batch,
+                    "steps_per_sec_1dev": round(sps_1, 2),
+                    "steps_per_sec_8dev": round(sps_8, 2),
+                    "speedup_8dev": round(speedup, 3),
+                    "efficiency": round(speedup / 8.0, 3),
+                },
+                "sharding_overhead_same_total_work": {
+                    "global_batch": 64,
+                    "steps_per_sec_1dev": round(sps_1_big, 2),
+                    "steps_per_sec_8dev": round(sps_8_big, 2),
+                    "ratio_8dev_vs_1dev": round(overhead_ratio, 3),
+                },
             }
         )
     )
@@ -198,7 +219,9 @@ def _run_scaling_subprocess() -> dict | None:
         out = subprocess.run(
             [sys.executable, __file__, "--scaling-child"],
             env=env,
-            timeout=900,
+            # 4 CPU-mesh fits (strong pair + same-work pair) — roughly
+            # double the original 2-fit child's work.
+            timeout=1800,
             check=True,
             capture_output=True,
             text=True,
@@ -286,7 +309,7 @@ def main() -> None:
                 None if nll_sps is None else round(nll_sps, 2)
             ),
             "batch_sweep_windows_per_sec": batch_sweep,
-            "scaling_fixed_global_batch": scaling,
+            "scaling": scaling,
         },
     }
     # The relay can wedge for HOURS (observed 2026-07-29: 3.5h+), far past

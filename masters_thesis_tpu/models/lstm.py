@@ -146,11 +146,9 @@ class LstmEncoder(nn.Module):
                         1.0 - self.dropout
                     )
                 else:
-                    # Eval cost note: the all-ones mask stashes a (T,B,H)
-                    # plane (~1.6 MB at the canonical shape) in the pair
-                    # kernel's VMEM budget; a maskless kernel variant would
-                    # save it, at the price of a second kernel surface.
-                    mask = jnp.ones((n_t, batch, hidden), self.compute_dtype)
+                    # Deterministic / dropout=0: the maskless kernel
+                    # variant — no (T,B,H) mask plane in VMEM at all.
+                    mask = None
 
                 run = lambda xp, w1, wi2, b2, w2, m: lstm_pair_recurrence(
                     xp, w1, wi2, b2, w2, m, impl=self.kernel_impl

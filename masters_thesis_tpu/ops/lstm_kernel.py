@@ -303,11 +303,18 @@ def pair_fusion_enabled() -> bool:
     return os.environ.get("MT_LSTM_FUSED_PAIR", "1") not in ("0", "")
 
 
-def _pair_fwd_kernel(
-    x1_ref, mask_ref, w1_ref, wi2_ref, b2_ref, w2_ref,
-    h2_out, h1_out, c1_out, c2_out,
-    h1_scr, c1_scr, h2_scr, c2_scr, x2_scr,
-):
+def _pair_fwd_kernel(*refs, has_mask=True):
+    # The dropout mask is an OPTIONAL input: deterministic/eval calls and
+    # dropout=0 training skip it entirely (no (T, B, H) all-ones plane in
+    # VMEM, no per-step multiply) — `has_mask` is static, bound by partial.
+    if has_mask:
+        (x1_ref, mask_ref, w1_ref, wi2_ref, b2_ref, w2_ref,
+         h2_out, h1_out, c1_out, c2_out,
+         h1_scr, c1_scr, h2_scr, c2_scr, x2_scr) = refs
+    else:
+        (x1_ref, w1_ref, wi2_ref, b2_ref, w2_ref,
+         h2_out, h1_out, c1_out, c2_out,
+         h1_scr, c1_scr, h2_scr, c2_scr, x2_scr) = refs
     n_t = x1_ref.shape[0]
     h1_scr[:] = jnp.zeros_like(h1_scr)
     c1_scr[:] = jnp.zeros_like(c1_scr)
@@ -350,9 +357,11 @@ def _pair_fwd_kernel(
             c1_scr[:] = c
             h1_out[s] = h.astype(h1_out.dtype)
             c1_out[s] = c.astype(c1_out.dtype)
+            h_seam = (
+                h * mask_ref[s].astype(jnp.float32) if has_mask else h
+            )
             x2_scr[:] = b2 + lax.dot_general(
-                h * mask_ref[s].astype(jnp.float32), wi2,
-                (((1,), (0,)), ((), ())),
+                h_seam, wi2, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
 
@@ -362,6 +371,8 @@ def _pair_fwd_kernel(
 
 
 def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
+    """mask may be None (deterministic / dropout=0): the maskless kernel
+    variant runs, with no mask plane in VMEM at all."""
     n_t, b, four_h = x1_proj.shape
     hidden = four_h // 4
     b_pad = -(-b // 8) * 8
@@ -370,7 +381,7 @@ def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
             f"fused layer pair supports <= {PAIR_MAX_ROWS} rows, got {b}"
         )
     x1_padded = _pad_rows(x1_proj, b_pad)
-    mask_padded = _pad_rows(mask, b_pad)
+    mask_padded = None if mask is None else _pad_rows(mask, b_pad)
     b2_row = b2.reshape(1, four_h)
 
     full_block = lambda width: pl.BlockSpec(  # noqa: E731
@@ -379,16 +390,22 @@ def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
     weight_block = lambda shape: pl.BlockSpec(  # noqa: E731
         shape, lambda: (0, 0), memory_space=pltpu.VMEM
     )
+    has_mask = mask is not None
+    in_specs = [full_block(four_h)]
+    inputs = [x1_padded]
+    if has_mask:
+        in_specs.append(full_block(hidden))
+        inputs.append(mask_padded)
+    in_specs += [
+        weight_block((hidden, four_h)),
+        weight_block((hidden, four_h)),
+        weight_block((1, four_h)),
+        weight_block((hidden, four_h)),
+    ]
+    inputs += [w1t, wi2t, b2_row, w2t]
     h2s, h1s, c1s, c2s = pl.pallas_call(
-        _pair_fwd_kernel,
-        in_specs=[
-            full_block(four_h),
-            full_block(hidden),
-            weight_block((hidden, four_h)),
-            weight_block((hidden, four_h)),
-            weight_block((1, four_h)),
-            weight_block((hidden, four_h)),
-        ],
+        functools.partial(_pair_fwd_kernel, has_mask=has_mask),
+        in_specs=in_specs,
         out_specs=[full_block(hidden)] * 4,
         out_shape=[
             jax.ShapeDtypeStruct((n_t, b_pad, hidden), x1_proj.dtype)
@@ -401,20 +418,26 @@ def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
             pltpu.VMEM((b_pad, four_h), jnp.float32),
         ],
         interpret=interpret,
-    )(x1_padded, mask_padded, w1t, wi2t, b2_row, w2t)
+    )(*inputs)
     res = (
         x1_padded, mask_padded, h1s, c1s, h2s, c2s, w1t, wi2t, b2_row, w2t, b
     )
     return h2s[:, :b], res
 
 
-def _pair_bwd_kernel(
-    dh2_ref, x1_ref, mask_ref, h1_ref, c1_ref, h2_ref, c2_ref,
-    w1_ref, wi2_ref, b2_ref, w2_ref,
-    dx1_out, dw1_out, dwi2_out, db2_out, dw2_out,
-    dh1_scr, dc1_scr, dh2_scr, dc2_scr,
-    dw1_scr, dwi2_scr, db2_scr, dw2_scr, dh1_in_scr,
-):
+def _pair_bwd_kernel(*refs, has_mask=True):
+    if has_mask:
+        (dh2_ref, x1_ref, mask_ref, h1_ref, c1_ref, h2_ref, c2_ref,
+         w1_ref, wi2_ref, b2_ref, w2_ref,
+         dx1_out, dw1_out, dwi2_out, db2_out, dw2_out,
+         dh1_scr, dc1_scr, dh2_scr, dc2_scr,
+         dw1_scr, dwi2_scr, db2_scr, dw2_scr, dh1_in_scr) = refs
+    else:
+        (dh2_ref, x1_ref, h1_ref, c1_ref, h2_ref, c2_ref,
+         w1_ref, wi2_ref, b2_ref, w2_ref,
+         dx1_out, dw1_out, dwi2_out, db2_out, dw2_out,
+         dh1_scr, dc1_scr, dh2_scr, dc2_scr,
+         dw1_scr, dwi2_scr, db2_scr, dw2_scr, dh1_in_scr) = refs
     n_t = dh2_ref.shape[0]
     for scr in (dh1_scr, dc1_scr, dh2_scr, dc2_scr,
                 dw1_scr, dwi2_scr, db2_scr, dw2_scr, dh1_in_scr):
@@ -476,8 +499,10 @@ def _pair_bwd_kernel(
             not_first = jnp.float32(1.0) - (t == 0).astype(jnp.float32)
             c_prev = c2_ref[t_prev].astype(jnp.float32) * not_first
             h_prev = h2_ref[t_prev].astype(jnp.float32) * not_first
-            mask_t = mask_ref[t].astype(jnp.float32)
-            h1m = h1_ref[t].astype(jnp.float32) * mask_t
+            h1m = h1_ref[t].astype(jnp.float32)
+            if has_mask:
+                mask_t = mask_ref[t].astype(jnp.float32)
+                h1m = h1m * mask_t
             # Recompute layer 2's input projection AND gates from VMEM
             # stashes (cheaper than stashing the (T, B, 4H) projection).
             x2 = b2 + lax.dot_general(
@@ -519,10 +544,11 @@ def _pair_bwd_kernel(
                 preferred_element_type=jnp.float32,
             )
             db2_scr[:] += jnp.sum(d_pre, axis=0, keepdims=True)
-            dh1_in_scr[:] = mask_t * lax.dot_general(
+            dh1_in = lax.dot_general(
                 d_pre, wi2, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            dh1_in_scr[:] = mask_t * dh1_in if has_mask else dh1_in
 
         return 0
 
@@ -546,21 +572,29 @@ def _pair_bwd_pallas(interpret, res, dh2s):
     weight_block = lambda shape: pl.BlockSpec(  # noqa: E731
         shape, lambda: (0, 0), memory_space=pltpu.VMEM
     )
+    has_mask = mask_padded is not None
+    in_specs = [
+        full_block(hidden),    # dh2s
+        full_block(four_h),    # x1_proj (aliased to dx1)
+    ]
+    inputs = [dh2s, x1_padded]
+    if has_mask:
+        in_specs.append(full_block(hidden))
+        inputs.append(mask_padded)
+    in_specs += [
+        full_block(hidden),    # h1s
+        full_block(hidden),    # c1s
+        full_block(hidden),    # h2s
+        full_block(hidden),    # c2s
+        weight_block((hidden, four_h)),
+        weight_block((hidden, four_h)),
+        weight_block((1, four_h)),
+        weight_block((hidden, four_h)),
+    ]
+    inputs += [h1s, c1s, h2s, c2s, w1t, wi2t, b2_row, w2t]
     dx1, dw1t, dwi2t, db2_row, dw2t = pl.pallas_call(
-        _pair_bwd_kernel,
-        in_specs=[
-            full_block(hidden),    # dh2s
-            full_block(four_h),    # x1_proj (aliased to dx1)
-            full_block(hidden),    # mask
-            full_block(hidden),    # h1s
-            full_block(hidden),    # c1s
-            full_block(hidden),    # h2s
-            full_block(hidden),    # c2s
-            weight_block((hidden, four_h)),
-            weight_block((hidden, four_h)),
-            weight_block((1, four_h)),
-            weight_block((hidden, four_h)),
-        ],
+        functools.partial(_pair_bwd_kernel, has_mask=has_mask),
+        in_specs=in_specs,
         out_specs=[
             full_block(four_h),
             weight_block((hidden, four_h)),
@@ -588,10 +622,12 @@ def _pair_bwd_pallas(interpret, res, dh2s):
         ],
         input_output_aliases={1: 0},
         interpret=interpret,
-    )(dh2s, x1_padded, mask_padded, h1s, c1s, h2s, c2s,
-      w1t, wi2t, b2_row, w2t)
-    dmask = jnp.zeros_like(mask_padded[:, :b])  # dropout mask: nondiff
-    return (dx1[:, :b], dw1t, dwi2t, db2_row.reshape(four_h), dw2t, dmask)
+    )(*inputs)
+    grads = (dx1[:, :b], dw1t, dwi2t, db2_row.reshape(four_h), dw2t)
+    if has_mask:
+        # dropout mask: nondiff
+        return grads + (jnp.zeros_like(mask_padded[:, :b]),)
+    return grads
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
@@ -612,10 +648,30 @@ def _pair_vjp_fwd(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, interpret):
 _lstm_pair_pallas.defvjp(_pair_vjp_fwd, _pair_bwd_pallas)
 
 
-def lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _lstm_pair_pallas_nomask(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t,
+                             interpret=False):
+    h2s, _ = _pair_fwd_pallas(
+        x1_proj, None, w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret=interpret
+    )
+    return h2s
+
+
+def _pair_nomask_vjp_fwd(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t,
+                         interpret):
+    return _pair_fwd_pallas(
+        x1_proj, None, w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret=interpret
+    )
+
+
+_lstm_pair_pallas_nomask.defvjp(_pair_nomask_vjp_fwd, _pair_bwd_pallas)
+
+
+def lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask=None):
     """Reference formulation of the fused pair: two scans + projection."""
     h1s = lstm_recurrence_xla(x1_proj, w_hh1_t)
-    x2_proj = (h1s * mask) @ w_ih2_t + bias2
+    seam = h1s if mask is None else h1s * mask
+    x2_proj = seam @ w_ih2_t + bias2
     return lstm_recurrence_xla(x2_proj, w_hh2_t)
 
 
@@ -625,7 +681,7 @@ def lstm_pair_recurrence(
     w_ih2_t: jax.Array,
     bias2: jax.Array,
     w_hh2_t: jax.Array,
-    mask: jax.Array,
+    mask: jax.Array | None = None,
     impl: str = "auto",
 ) -> jax.Array:
     """Run TWO stacked LSTM layers as one fused wavefront recurrence.
@@ -637,9 +693,10 @@ def lstm_pair_recurrence(
         w_ih2_t: ``(H, 4H)`` transposed layer-2 input weight.
         bias2: ``(4H,)`` layer-2 combined bias (``b_ih + b_hh``).
         w_hh2_t: ``(H, 4H)`` transposed layer-2 recurrent weight.
-        mask: ``(T, B, H)`` inter-layer dropout mask (already scaled by
-            ``1/(1-p)``; all-ones when deterministic), applied to layer-1
-            outputs before the layer-2 projection.
+        mask: optional ``(T, B, H)`` inter-layer dropout mask (already
+            scaled by ``1/(1-p)``), applied to layer-1 outputs before the
+            layer-2 projection. ``None`` (deterministic / dropout=0) runs
+            the maskless kernel variant — no mask plane in VMEM.
         impl: ``"pallas"`` | ``"xla"`` | ``"interpret"`` | ``"auto"``.
 
     Returns:
@@ -653,13 +710,14 @@ def lstm_pair_recurrence(
         )
     if impl in ("pallas", "interpret") and not pair_rows_ok(x1_proj.shape[1]):
         impl = "xla"  # residual stash would not fit one VMEM program
-    if impl == "pallas":
+    if impl in ("pallas", "interpret"):
+        interpret = impl == "interpret"
+        if mask is None:
+            return _lstm_pair_pallas_nomask(
+                x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret
+            )
         return _lstm_pair_pallas(
-            x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, False
-        )
-    if impl == "interpret":
-        return _lstm_pair_pallas(
-            x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, True
+            x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask, interpret
         )
     if impl == "xla":
         return lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask)

@@ -126,23 +126,46 @@ def run_cell(
     log(f"train {cell}")
     t0 = time.time()
     truncated = False
-    try:
-        train = subprocess.run(
-            [sys.executable, "train.py", *train_overrides,
-             "trainer.resume=true", "trainer.enable_model_summary=false"],
-            cwd=REPO,
-            timeout=budget,
-            capture_output=True,
-            text=True,
-        )
-        if train.returncode != 0:
-            log(f"{cell}: train FAILED rc={train.returncode}\n"
-                f"{train.stdout[-1500:]}\n{train.stderr[-1500:]}")
-            return
-    except subprocess.TimeoutExpired:
-        truncated = True
-        log(f"{cell}: train hit the {budget:.0f}s cap; evaluating the last "
-            "checkpoint (resume will continue it on a re-run)")
+    attempts = 0
+    while True:
+        attempts += 1
+        remaining = budget - (time.time() - t0)
+        if remaining <= 60:
+            truncated = True
+            log(f"{cell}: cell budget exhausted before attempt {attempts}; "
+                "evaluating the last checkpoint")
+            break
+        try:
+            train = subprocess.run(
+                [sys.executable, "train.py", *train_overrides,
+                 "trainer.resume=true", "trainer.enable_model_summary=false"],
+                cwd=REPO,
+                timeout=remaining,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            truncated = True
+            log(f"{cell}: train hit its cap after {remaining:.0f}s "
+                f"(cell budget {budget:.0f}s); evaluating the last "
+                "checkpoint (resume will continue it on a re-run)")
+            break
+        if train.returncode == 0:
+            break
+        tail = train.stdout[-1500:] + train.stderr[-1500:]
+        # A wedged/crashed relay surfaces as UNAVAILABLE backend errors —
+        # transient, not a property of the cell. Re-probe the TPU and give
+        # the cell ONE more attempt (trainer.resume=true makes the retry
+        # continue from the last val-epoch checkpoint, not restart). The
+        # budget re-check at the top of the loop keeps a long wedge inside
+        # wait_for_tpu from granting an attempt past the deadline.
+        transient = "UNAVAILABLE" in tail or "Unavailable" in tail
+        if transient and attempts == 1 and wait_for_tpu(deadline):
+            log(f"{cell}: transient backend failure; retrying once")
+            continue
+        log(f"{cell}: train FAILED rc={train.returncode}\n"
+            f"{train.stdout[-1500:]}\n{train.stderr[-1500:]}")
+        return
     wall = time.time() - t0
 
     if not ckpt.exists():

@@ -110,12 +110,15 @@ def test_row_tile_env_override_parity(rng, monkeypatch):
 
 
 def _random_pair_case(rng, n_t, b, hidden, *, dropout=0.0):
+    """dropout=None -> maskless variant (mask arg is None)."""
     x1 = jnp.asarray(rng.normal(size=(n_t, b, 4 * hidden)), jnp.float32)
     w1 = jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
     wi2 = jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
     b2 = jnp.asarray(rng.normal(size=(4 * hidden,)) * 0.1, jnp.float32)
     w2 = jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
-    if dropout:
+    if dropout is None:
+        mask = None
+    elif dropout:
         keep = rng.random(size=(n_t, b, hidden)) > dropout
         mask = jnp.asarray(keep / (1.0 - dropout), jnp.float32)
     else:
@@ -126,10 +129,12 @@ def _random_pair_case(rng, n_t, b, hidden, *, dropout=0.0):
 @pytest.mark.parametrize(
     "n_t,b,hidden,dropout",
     [
-        (5, 4, 8, 0.0),       # tiny
+        (5, 4, 8, 0.0),       # tiny, all-ones mask
+        (5, 4, 8, None),      # tiny, MASKLESS variant
         (5, 4, 8, 0.3),       # with a dropout mask in the seam
-        (3, 13, 8, 0.0),      # row remainder -> padding path
+        (3, 13, 8, None),     # row remainder + maskless
         (60, 100, 64, 0.2),   # the reference workload shape (model=small)
+        (60, 100, 64, None),  # the reference EVAL shape (maskless)
     ],
 )
 def test_pair_forward_parity(rng, n_t, b, hidden, dropout):
@@ -142,7 +147,7 @@ def test_pair_forward_parity(rng, n_t, b, hidden, dropout):
 
 @pytest.mark.parametrize(
     "n_t,b,hidden,dropout",
-    [(5, 4, 8, 0.0), (6, 13, 16, 0.3), (12, 40, 16, 0.2)],
+    [(5, 4, 8, 0.0), (5, 4, 8, None), (6, 13, 16, 0.3), (12, 40, 16, 0.2)],
 )
 def test_pair_gradient_parity(rng, n_t, b, hidden, dropout):
     args = _random_pair_case(rng, n_t, b, hidden, dropout=dropout)
