@@ -113,3 +113,62 @@ def test_quadratic_features_composition(interaction_only, include_bias):
     )
     if include_bias:
         np.testing.assert_array_equal(out[..., -1], np.ones_like(out[..., -1]))
+
+
+# ---- fused layer-pair kernel: parity law over random shapes -------------
+
+@st.composite
+def pair_case(draw):
+    n_t = draw(st.integers(1, 7))
+    b = draw(st.integers(1, 20))
+    hidden = draw(st.sampled_from([8, 16]))
+    mask_mode = draw(st.sampled_from(["none", "ones", "dropout"]))
+    return n_t, b, hidden, mask_mode
+
+
+@given(pair_case())
+@settings(max_examples=10, deadline=None)
+def test_pair_kernel_matches_scan_for_any_shape(case):
+    """LAW: for every (T, B, H, mask) the fused wavefront Pallas program
+    (interpreter mode) computes the same outputs AND gradients as the
+    two-scan composition — including T=1 (empty wavefront overlap), B=1,
+    and row-padding remainders the parametrized tests don't enumerate."""
+    import jax
+    import jax.numpy as jnp
+
+    from masters_thesis_tpu.ops.lstm_kernel import (
+        lstm_pair_recurrence,
+        lstm_pair_xla,
+    )
+
+    n_t, b, hidden, mask_mode = case
+    rng = np.random.default_rng(n_t * 1000 + b * 10 + hidden)
+    x1 = jnp.asarray(rng.normal(size=(n_t, b, 4 * hidden)), jnp.float32)
+    w1, wi2, w2 = (
+        jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
+        for _ in range(3)
+    )
+    b2 = jnp.asarray(rng.normal(size=(4 * hidden,)) * 0.1, jnp.float32)
+    if mask_mode == "none":
+        mask = None
+    elif mask_mode == "ones":
+        mask = jnp.ones((n_t, b, hidden), jnp.float32)
+    else:
+        keep = rng.random(size=(n_t, b, hidden)) > 0.25
+        mask = jnp.asarray(keep / 0.75, jnp.float32)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a, mask) ** 2)
+
+    ref = jax.value_and_grad(loss(lstm_pair_xla), argnums=(0, 1, 2, 3, 4))(
+        x1, w1, wi2, b2, w2
+    )
+    out = jax.value_and_grad(
+        loss(lambda *a, **k: lstm_pair_recurrence(*a, **k, impl="interpret")),
+        argnums=(0, 1, 2, 3, 4),
+    )(x1, w1, wi2, b2, w2)
+    np.testing.assert_allclose(float(out[0]), float(ref[0]), rtol=1e-4)
+    for g_pl, g_ref in zip(out[1], ref[1]):
+        np.testing.assert_allclose(
+            np.asarray(g_pl), np.asarray(g_ref), atol=3e-4
+        )
