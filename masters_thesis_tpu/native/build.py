@@ -7,6 +7,11 @@ cache safe to keep across versions. No pybind11/setuptools machinery: the
 engine exposes a plain C ABI consumed via ctypes (see engine.py), so the only
 build dependency is a C++ compiler; when none is present the framework
 transparently falls back to the pure-JAX pipeline path.
+
+The engine replaces the host-side throughput the reference buys with
+DataLoader worker processes and pinned memory (reference:
+src/data.py:237-244) — see native/window_engine.cpp for the threaded
+window/feature pipeline itself.
 """
 
 from __future__ import annotations

@@ -6,6 +6,11 @@ at a stable on-disk location makes every job after the first start hot
 (same-shape programs are fetched instead of recompiled). Off by default in
 library code — the CLI drivers opt in (set ``MT_NO_COMPILE_CACHE=1`` to
 disable, e.g. when benchmarking compile time itself).
+
+The reference's analog is ``model.compile()`` — torch.compile graph
+capture redone from scratch every process (reference: train.py:137); the
+persistent cache is what makes whole-program jit compilation cheaper than
+that across sweep jobs, not just within one.
 """
 
 from __future__ import annotations

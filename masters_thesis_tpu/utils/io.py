@@ -6,6 +6,11 @@ then atomic rename; the tmp name carries a uuid (pids alone are only unique
 per host) so concurrent writers — including processes on different hosts
 sharing a filesystem — each use their own scratch file and the last rename
 wins with an intact artifact.
+
+The reference gets torn-file safety implicitly from Lightning's checkpoint
+machinery and writes its dataset cache with a bare ``torch.save``
+(reference: src/data.py:216-219, train.py:151-161); here the invariant is
+owned explicitly and shared by every writer.
 """
 
 from __future__ import annotations
