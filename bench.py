@@ -302,14 +302,21 @@ def main() -> None:
             "device": jax.devices()[0].platform,
             "probe_attempts": probe_attempts,
             # Whether pair fusion was ENABLED (env kill-switch); the Pallas
-            # pair kernel additionally requires a TPU backend and <=104
-            # rows — on the degraded CPU path it lowers to the scan form.
+            # pair kernel additionally requires a TPU backend and a shape
+            # inside the VMEM byte budget (ops/lstm_kernel.py pair_fits) —
+            # on the degraded CPU path it lowers to the scan form.
             "fused_pair_enabled": _fused_pair_enabled(),
             "nll_steps_per_sec": (
                 None if nll_sps is None else round(nll_sps, 2)
             ),
             "batch_sweep_windows_per_sec": batch_sweep,
             "scaling": scaling,
+            # r2/r3 artifacts exposed the strong-scaling record under this
+            # key; aliased for one round so cross-round consumers keep
+            # resolving it (ADVICE r3).
+            "scaling_fixed_global_batch": (
+                scaling.get("strong_fixed_global_batch") if scaling else None
+            ),
         },
     }
     # The relay can wedge for HOURS (observed 2026-07-29: 3.5h+), far past

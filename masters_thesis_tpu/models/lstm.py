@@ -40,8 +40,8 @@ from jax import Array
 from masters_thesis_tpu.ops.lstm_kernel import (
     lstm_pair_recurrence,
     lstm_recurrence,
+    pair_fits,
     pair_fusion_enabled,
-    pair_rows_ok,
 )
 
 
@@ -92,8 +92,10 @@ class LstmEncoder(nn.Module):
 
         # The fused layer-pair kernel halves the serial recurrence chain by
         # running consecutive layers as a wavefront inside ONE Pallas
-        # program (ops/lstm_kernel.py). It covers the reference's row count
-        # (~100-stock windows); larger batches keep the per-layer path.
+        # program (ops/lstm_kernel.py). It covers the reference's shape
+        # (~100-stock windows at T=60/H=64); bigger batches, lookbacks, or
+        # hidden sizes that would blow the pair's VMEM budget keep the
+        # per-layer path (byte-based check, not a row-count constant).
         # The pair GROUPING applies on every backend (on non-TPU,
         # lstm_pair_recurrence lowers to an equivalent scan formulation),
         # so the fused branch's dropout mask draw — one explicit bernoulli
@@ -101,7 +103,10 @@ class LstmEncoder(nn.Module):
         # Both paths are parity-tested.
         fuse_pairs = (
             pair_fusion_enabled()
-            and pair_rows_ok(batch)
+            and pair_fits(
+                x.shape[1], batch, hidden,
+                has_mask=self.dropout > 0.0 and not deterministic,
+            )
             and self.kernel_impl in ("auto", "pallas", "interpret")
         )
 

@@ -280,17 +280,45 @@ def _bwd_pallas(interpret, residuals, dhs):
 #
 # Single-program only: the pair's residual stash (x1_proj + mask + four
 # state planes) fits VMEM for the reference's ~100-row windows but not for
-# large batches; callers fall back to the per-layer path above when rows
-# exceed PAIR_MAX_ROWS (the backward aliases dx1 over x1_proj, same
-# hazard-free trick as the single-layer kernel, which is what keeps the
-# whole thing under the ~16 MB VMEM budget).
+# large batches — and the footprint scales with T and hidden too, not just
+# rows. Feasibility is therefore a BYTE check: the backward program (the
+# high-water mark; it holds every plane the forward does plus the gradient
+# scratch, with dx1 aliased over x1_proj) must fit under the byte budget of
+# the known-good canonical shape (T=60, 104 rows, H=64, mask present —
+# measured working on TPU v5e, RESULTS.md). Callers fall back to the
+# per-layer/xla path when the check fails instead of hitting a Mosaic
+# scoped-VMEM compile error.
 
-PAIR_MAX_ROWS = 104
+
+def _pair_bwd_vmem_bytes(
+    n_t: int, b_pad: int, hidden: int, has_mask: bool, itemsize: int = 4
+) -> int:
+    """VMEM footprint of the fused-pair BACKWARD program, in bytes."""
+    four_h = 4 * hidden
+    # (T, B, H) planes: dh2 + h1/c1/h2/c2 stashes (+ optional mask).
+    planes = n_t * b_pad * hidden * (5 + int(has_mask))
+    # (T, B, 4H): x1_proj, aliased over the dx1 output (counted once).
+    planes += n_t * b_pad * four_h
+    scratch = 5 * b_pad * hidden + 3 * hidden * four_h + four_h
+    weights_in = 3 * hidden * four_h + four_h  # w1t, wi2t, w2t + bias row
+    grads_out = 3 * hidden * four_h + four_h
+    return (planes + scratch + weights_in + grads_out) * itemsize
 
 
-def pair_rows_ok(b: int) -> bool:
-    """True when a b-row layer pair fits the single-program fused kernel."""
-    return -(-b // 8) * 8 <= PAIR_MAX_ROWS
+_PAIR_VMEM_BUDGET = _pair_bwd_vmem_bytes(60, 104, 64, True)
+
+
+def pair_fits(n_t: int, b: int, hidden: int, has_mask: bool = True) -> bool:
+    """True when a (T=n_t, rows=b, H=hidden) layer pair fits the fused
+    single-program kernel's VMEM budget (conservatively assumes the
+    dropout-mask plane is present unless told otherwise)."""
+    b_pad = -(-b // 8) * 8
+    return _pair_bwd_vmem_bytes(n_t, b_pad, hidden, has_mask) <= _PAIR_VMEM_BUDGET
+
+
+def pair_rows_ok(b: int, n_t: int = 60, hidden: int = 64) -> bool:
+    """Row-count feasibility at the canonical window shape (T=60, H=64)."""
+    return pair_fits(n_t, b, hidden)
 
 
 def pair_fusion_enabled() -> bool:
@@ -298,9 +326,10 @@ def pair_fusion_enabled() -> bool:
 
     Default ON: measured 1.14x (model=small) / 1.16x (model=medium)
     train-step throughput on TPU v5e vs the per-layer kernels
-    (sweeps/bench_fused_pair.py, RESULTS.md).
+    (sweeps/bench_fused_pair.py, RESULTS.md). Any value other than the
+    literal "0" — including unset or empty — leaves fusion enabled.
     """
-    return os.environ.get("MT_LSTM_FUSED_PAIR", "1") not in ("0", "")
+    return os.environ.get("MT_LSTM_FUSED_PAIR", "1") != "0"
 
 
 def _pair_fwd_kernel(*refs, has_mask=True):
@@ -376,9 +405,10 @@ def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
     n_t, b, four_h = x1_proj.shape
     hidden = four_h // 4
     b_pad = -(-b // 8) * 8
-    if b_pad > PAIR_MAX_ROWS:
+    if not pair_fits(n_t, b, hidden, has_mask=mask is not None):
         raise ValueError(
-            f"fused layer pair supports <= {PAIR_MAX_ROWS} rows, got {b}"
+            f"fused layer pair exceeds the VMEM budget at "
+            f"(T={n_t}, rows={b}, H={hidden})"
         )
     x1_padded = _pad_rows(x1_proj, b_pad)
     mask_padded = None if mask is None else _pad_rows(mask, b_pad)
@@ -708,7 +738,10 @@ def lstm_pair_recurrence(
             if os.environ.get("MT_TPU_DISABLE_PALLAS")
             else ("pallas" if jax.default_backend() == "tpu" else "xla")
         )
-    if impl in ("pallas", "interpret") and not pair_rows_ok(x1_proj.shape[1]):
+    if impl in ("pallas", "interpret") and not pair_fits(
+        x1_proj.shape[0], x1_proj.shape[1], w_hh1_t.shape[0],
+        has_mask=mask is not None,
+    ):
         impl = "xla"  # residual stash would not fit one VMEM program
     if impl in ("pallas", "interpret"):
         interpret = impl == "interpret"

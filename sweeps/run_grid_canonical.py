@@ -84,6 +84,38 @@ def wait_for_tpu(deadline: float) -> bool:
     return False
 
 
+def maybe_run_bench(deadline: float) -> None:
+    """Opportunistic bench capture: if results/BENCH_REQUEST exists when the
+    device probe has just passed, run bench.py NOW (the relay is healthy at
+    this instant — the best moment for the round's primary perf evidence)
+    and append its JSON line to results/bench_opportunistic.jsonl. The
+    marker is consumed either way; re-touch it to request another capture.
+    The subprocess timeout is capped by the runner's deadline, same as
+    cells."""
+    req = RESULTS_DIR / "BENCH_REQUEST"
+    if not req.exists():
+        return
+    budget = min(3600.0, deadline - time.time())
+    if budget < 300:
+        return  # too close to the deadline to spend TPU time on a bench
+    log("BENCH_REQUEST: relay healthy, capturing bench.py")
+    try:
+        out = subprocess.run(
+            [sys.executable, "bench.py"],
+            cwd=REPO, timeout=budget, capture_output=True, text=True,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            with open(RESULTS_DIR / "bench_opportunistic.jsonl", "a") as f:
+                f.write(out.stdout.strip().splitlines()[-1] + "\n")
+            log("bench captured -> results/bench_opportunistic.jsonl")
+        else:
+            log(f"bench failed rc={out.returncode}: {out.stderr[-500:]}")
+    except subprocess.TimeoutExpired:
+        log(f"bench timed out after {budget:.0f}s")
+    finally:
+        req.unlink(missing_ok=True)
+
+
 def done_cells() -> set:
     """Cells with a COMPLETE recorded run. Truncated rows don't count: a
     re-run resumes them from their last checkpoint and appends a fresher
@@ -116,6 +148,7 @@ def run_cell(
     if not wait_for_tpu(deadline):
         log(f"skip {cell}: TPU never became ready before deadline")
         return
+    maybe_run_bench(deadline)
     # Budget AFTER the TPU wait: a long wedge must shrink the cell's cap,
     # not let the subprocess run past the deadline.
     budget = min(PER_CELL_CAP_S, deadline - time.time())
@@ -152,14 +185,16 @@ def run_cell(
             break
         if train.returncode == 0:
             break
-        tail = train.stdout[-1500:] + train.stderr[-1500:]
         # A wedged/crashed relay surfaces as UNAVAILABLE backend errors —
         # transient, not a property of the cell. Re-probe the TPU and give
         # the cell ONE more attempt (trainer.resume=true makes the retry
         # continue from the last val-epoch checkpoint, not restart). The
         # budget re-check at the top of the loop keeps a long wedge inside
-        # wait_for_tpu from granting an attempt past the deadline.
-        transient = "UNAVAILABLE" in tail or "Unavailable" in tail
+        # wait_for_tpu from granting an attempt past the deadline. Search
+        # the FULL captured output — progress lines after the backend error
+        # can push the marker out of any fixed-size tail.
+        full = train.stdout + train.stderr
+        transient = "UNAVAILABLE" in full or "Unavailable" in full
         if transient and attempts == 1 and wait_for_tpu(deadline):
             log(f"{cell}: transient backend failure; retrying once")
             continue

@@ -64,6 +64,33 @@ def test_load_masks_sentinel_rows(tmp_path):
     assert np.all(np.isfinite(p25_clean)), "surviving rows must be NaN-free"
 
 
+def test_sentinel_on_nonzero_rf_day_pins_reference_deviation(tmp_path):
+    """Pin the DIRECTION of the conscious deviation from the reference
+    (src/data.py:112-115): the reference masks sentinels AFTER subtracting
+    RF, so on a day with nonzero RF the value ``-99.99 - RF`` no longer
+    equals the sentinel, escapes the reference's mask, and
+    ``log(-99.99 - RF + 100)`` goes NaN. This loader masks on the RAW
+    values and drops the row. Net effect vs the reference on such a day:
+    exactly one fewer (clean) sample instead of one NaN-poisoned sample."""
+    i0 = FF.skip_old_data
+    bad_day = i0 + 3
+    n_rows = FF.skip_old_data + 200
+    _write_fixtures(tmp_path, n_rows, sentinel_rows={bad_day})
+    p25, mkt = FF.load(tmp_path)
+    _write_fixtures(tmp_path, n_rows)  # same data, no sentinel
+    p25_full, mkt_full = FF.load(tmp_path)
+
+    rf = 0.001 * bad_day  # the fixture's RF on the sentinel day — nonzero
+    assert rf > 0 and (-99.99 - rf) != -99.99  # escapes the reference mask
+    # The reference's log transform on the escaped value injects NaN:
+    with np.errstate(invalid="ignore"):
+        ref_value = 100.0 * (np.log(-99.99 - rf + 100.0) - np.log(100.0))
+    assert not np.isfinite(ref_value)
+    # This loader instead drops the day — one fewer sample, all finite.
+    assert mkt.shape[0] == mkt_full.shape[0] - 1
+    assert np.all(np.isfinite(p25)) and np.all(np.isfinite(mkt))
+
+
 def test_load_missing_file_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         FF.load(tmp_path)

@@ -16,6 +16,7 @@ from masters_thesis_tpu.ops.lstm_kernel import (
     lstm_pair_xla,
     lstm_recurrence,
     lstm_recurrence_xla,
+    pair_fits,
     pair_rows_ok,
 )
 
@@ -176,18 +177,29 @@ def test_pair_gradient_parity(rng, n_t, b, hidden, dropout):
 
 
 def test_pair_rows_guard():
+    # Canonical window shape (T=60, H=64): the measured-working envelope.
     assert pair_rows_ok(100)
     assert pair_rows_ok(104)
     assert not pair_rows_ok(105)
     assert not pair_rows_ok(800)
+    # The feasibility check is BYTE-based (ADVICE r3): growing T or hidden
+    # past the canonical envelope must also reject, and small-T/H shapes
+    # admit more rows than the old 104-row constant.
+    assert pair_fits(60, 104, 64, True)
+    assert not pair_fits(120, 104, 64, True)   # 2x lookback blows VMEM
+    assert not pair_fits(60, 104, 128, True)   # 2x hidden blows VMEM
+    assert pair_fits(3, 800, 8, True)          # tiny T/H: many rows fit
+    # Maskless drops a (T,B,H) plane -> strictly more headroom.
+    assert pair_fits(60, 112, 64, False)
 
 
 def test_pair_large_rows_falls_back_to_xla(rng):
-    """Above the VMEM row bound the pair API silently uses the scan path."""
-    args = _random_pair_case(rng, 3, 120, 8)
+    """Above the VMEM budget the pair API silently uses the scan path."""
+    args = _random_pair_case(rng, 60, 120, 64)
+    assert not pair_fits(60, 120, 64, True)
     out = lstm_pair_recurrence(*args, impl="interpret")
     ref = lstm_pair_xla(*args)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
 def test_encoder_fused_pair_matches_unfused(rng, monkeypatch):
