@@ -174,17 +174,29 @@ def _scaling_child() -> None:
     sps_1 = run(1, global_batch)  # 1 device x 8 windows/step
     sps_8 = run(8, 1)  # 8 devices x 1 window/step, pmean over the mesh
     speedup = sps_8 / sps_1 if sps_1 > 0 else 0.0
-    # Sharding overhead at SAME TOTAL WORK: 1 device x 64-window steps vs
-    # 8 devices x 8 windows each (64 global). On a virtual mesh the
-    # devices share the host's cores, so true weak scaling is unmeasurable
-    # (bounded at 1/n by construction); holding total work fixed instead
-    # isolates what sharding the program costs — partitioning, psum
-    # collectives, per-device dispatch. Ideal ratio 1.0; on real chips
-    # (separate compute per device) this same program shape is the weak-
-    # scaling step, so the overhead measured here is the transferable part.
-    sps_1_big = run(1, 64)
-    sps_8_big = run(8, 8)  # 64 global, sharded 8 ways
-    overhead_ratio = sps_8_big / sps_1_big if sps_1_big > 0 else 0.0
+    # WEAK-scaling curve at fixed windows/device (8), n = 1/2/4/8 devices.
+    # On a virtual mesh the devices share the host's core(s), so wall-clock
+    # weak scaling is bounded at 1/n by construction; the transferable
+    # quantity is PROGRAM efficiency: n-device sharded throughput vs ONE
+    # device running the same total windows per step unsharded. That ratio
+    # isolates what sharding costs — partitioning, psum collectives,
+    # per-device dispatch (ideal 1.0). On real chips each device brings its
+    # own compute, so this same program shape IS the weak-scaling step and
+    # the ratio here is the efficiency to expect (BASELINE.json north star:
+    # scaling eff 1→8→32).
+    per_dev = 8
+    weak = {}
+    for n in (2, 4, 8):
+        sps_unsharded = run(1, per_dev * n)  # same total work, no mesh
+        sps_sharded = run(n, per_dev)        # n devices x 8 windows each
+        weak[str(n)] = {
+            "global_batch": per_dev * n,
+            "steps_per_sec_1dev_unsharded": round(sps_unsharded, 2),
+            f"steps_per_sec_{n}dev_sharded": round(sps_sharded, 2),
+            "program_efficiency": round(
+                sps_sharded / sps_unsharded if sps_unsharded > 0 else 0.0, 3
+            ),
+        }
     print(
         json.dumps(
             {
@@ -195,11 +207,19 @@ def _scaling_child() -> None:
                     "speedup_8dev": round(speedup, 3),
                     "efficiency": round(speedup / 8.0, 3),
                 },
+                "weak_fixed_windows_per_device": {
+                    "windows_per_device": per_dev,
+                    "by_devices": weak,
+                },
+                # r3 alias: the n=8 weak point is the same-total-work
+                # sharding-overhead measurement previous rounds reported.
                 "sharding_overhead_same_total_work": {
                     "global_batch": 64,
-                    "steps_per_sec_1dev": round(sps_1_big, 2),
-                    "steps_per_sec_8dev": round(sps_8_big, 2),
-                    "ratio_8dev_vs_1dev": round(overhead_ratio, 3),
+                    "steps_per_sec_1dev": weak["8"][
+                        "steps_per_sec_1dev_unsharded"
+                    ],
+                    "steps_per_sec_8dev": weak["8"]["steps_per_sec_8dev_sharded"],
+                    "ratio_8dev_vs_1dev": weak["8"]["program_efficiency"],
                 },
             }
         )
@@ -219,9 +239,9 @@ def _run_scaling_subprocess() -> dict | None:
         out = subprocess.run(
             [sys.executable, __file__, "--scaling-child"],
             env=env,
-            # 4 CPU-mesh fits (strong pair + same-work pair) — roughly
-            # double the original 2-fit child's work.
-            timeout=1800,
+            # 8 CPU-mesh fits (strong pair + 3-point weak curve, sharded
+            # and unsharded sides).
+            timeout=3000,
             check=True,
             capture_output=True,
             text=True,

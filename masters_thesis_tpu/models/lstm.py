@@ -38,10 +38,13 @@ import jax.numpy as jnp
 from jax import Array
 
 from masters_thesis_tpu.ops.lstm_kernel import (
+    window_schedulable,
     lstm_pair_recurrence,
     lstm_recurrence,
-    pair_fits,
+    lstm_stack_recurrence,
     pair_fusion_enabled,
+    stack_fits,
+    wavefront_enabled,
 )
 
 
@@ -74,13 +77,23 @@ class LstmEncoder(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: Array, *, deterministic: bool = True
+        self,
+        x: Array,
+        *,
+        deterministic: bool = True,
+        window_rows: int | None = None,
     ) -> tuple[Array, Array]:
         """Encode lookback windows into per-row (alpha, beta) estimates.
 
         Args:
             x: ``(batch, time, features)`` feature-expanded lookback windows.
             deterministic: disables inter-layer dropout (eval mode).
+            window_rows: rows per window when ``batch`` is a flattened
+                stack of independent windows (the train/eval steps flatten
+                ``(B, K)`` into rows); lets the recurrence schedule big
+                batches window-per-Pallas-program instead of falling onto
+                the row-tiled grid (ops/lstm_kernel.py, window-granular
+                section).
 
         Returns:
             ``(alpha, beta)``, each ``(batch, 1)`` float32.
@@ -90,25 +103,58 @@ class LstmEncoder(nn.Module):
         init = _torch_lstm_init(scale)
         batch = x.shape[0]
 
-        # The fused layer-pair kernel halves the serial recurrence chain by
-        # running consecutive layers as a wavefront inside ONE Pallas
-        # program (ops/lstm_kernel.py). It covers the reference's shape
-        # (~100-stock windows at T=60/H=64); bigger batches, lookbacks, or
-        # hidden sizes that would blow the pair's VMEM budget keep the
-        # per-layer path (byte-based check, not a row-count constant).
-        # The pair GROUPING applies on every backend (on non-TPU,
-        # lstm_pair_recurrence lowers to an equivalent scan formulation),
-        # so the fused branch's dropout mask draw — one explicit bernoulli
-        # per pair instead of nn.Dropout's — is the same on all backends.
-        # Both paths are parity-tested.
-        fuse_pairs = (
-            pair_fusion_enabled()
-            and pair_fits(
-                x.shape[1], batch, hidden,
-                has_mask=self.dropout > 0.0 and not deterministic,
+        # Wavefront fusion: consecutive layers run inside ONE Pallas
+        # program (layer l at step t alongside layer l+1 at t-1 ...), which
+        # cuts the serial recurrence chain from L*T to ~T+L
+        # (ops/lstm_kernel.py). How DEEP a wavefront fits is a VMEM byte
+        # question: at the canonical f32 shape the budget caps depth at 2
+        # (the pair kernel, +14-16% measured); in bf16 compute every stash
+        # plane halves and 4-5 deep wavefronts fit — the deep-model chain
+        # shortener. Layers are grouped greedily into the deepest fused
+        # block that fits; shapes over budget keep the per-layer path
+        # unless window-granular scheduling applies (window_rows).
+        # The GROUPING applies on every backend (on non-TPU the fused calls
+        # lower to equivalent scan formulations), so the fused branches'
+        # dropout mask draws — one explicit bernoulli per seam instead of
+        # nn.Dropout's — are the same on all backends. All paths are
+        # parity-tested.
+        has_mask = self.dropout > 0.0 and not deterministic
+        n_t = x.shape[1]
+        itemsize = jnp.dtype(self.compute_dtype).itemsize
+
+        def depth_fits(depth: int) -> bool:
+            return stack_fits(
+                n_t, batch, hidden, depth, has_mask, itemsize
+            ) or (
+                window_schedulable(batch, window_rows)
+                and stack_fits(
+                    n_t, window_rows, hidden, depth, has_mask, itemsize
+                )
             )
-            and self.kernel_impl in ("auto", "pallas", "interpret")
-        )
+
+        def fused_depth(start: int) -> int:
+            """Deepest wavefront starting at layer ``start`` (1 = unfused)."""
+            if (
+                not pair_fusion_enabled()
+                or self.kernel_impl not in ("auto", "pallas", "interpret")
+            ):
+                return 1
+            limit = self.num_layers - start
+            if not wavefront_enabled():
+                limit = min(limit, 2)
+            depth = 1
+            while depth < limit and depth_fits(depth + 1):
+                depth += 1
+            return depth
+
+        def draw_mask():
+            if not has_mask:
+                return None
+            keep = jax.random.bernoulli(
+                self.make_rng("dropout"), 1.0 - self.dropout,
+                (n_t, batch, hidden),
+            )
+            return keep.astype(self.compute_dtype) / (1.0 - self.dropout)
 
         def layer_params(layer: int, in_dim: int):
             w_ih = self.param(f"w_ih_l{layer}", init, (4 * hidden, in_dim))
@@ -130,33 +176,48 @@ class LstmEncoder(nn.Module):
             )  # (B, T, 4H)
 
             w_hh_t = w_hh.T.astype(self.compute_dtype)
+            depth = fused_depth(layer)
 
-            if fuse_pairs and layer + 1 < self.num_layers:
+            if depth >= 3:
+                # Deep wavefront: the group's seam projections and dropout
+                # move inside the kernel. Mask draws come from the same
+                # 'dropout' RNG collection as nn.Dropout but are
+                # independent samples, so fused/unfused training runs are
+                # statistically (not bitwise) identical under dropout.
+                w_hhs, w_ins, biases, masks = [w_hh_t], [], [], []
+                for off in range(1, depth):
+                    wi_l, whh_l, bi_l, bh_l = layer_params(
+                        layer + off, hidden
+                    )
+                    w_hhs.append(whh_l.T.astype(self.compute_dtype))
+                    w_ins.append(wi_l.T.astype(self.compute_dtype))
+                    biases.append((bi_l + bh_l).astype(self.compute_dtype))
+                    if has_mask:
+                        masks.append(draw_mask())
+                run = lambda xp, weights, m: lstm_stack_recurrence(
+                    xp, weights, m, impl=self.kernel_impl,
+                    window_rows=window_rows,
+                )
+                if self.remat:
+                    run = jax.checkpoint(run)
+                hs = run(
+                    jnp.swapaxes(x_proj, 0, 1),
+                    (tuple(w_hhs), tuple(w_ins), tuple(biases)),
+                    tuple(masks) if has_mask else None,
+                )
+                layer += depth
+            elif depth == 2:
                 w_ih2, w_hh2, b_ih2, b_hh2 = layer_params(layer + 1, hidden)
-                n_t = x.shape[1]
                 # Inter-layer dropout moves inside the kernel as a
                 # precomputed, pre-scaled mask (torch semantics: dropout on
                 # every layer's output except the last — within a pair the
-                # first layer is never the last). Mask draws come from the
-                # same 'dropout' RNG collection as nn.Dropout but are
-                # independent samples, so fused/unfused training runs are
-                # statistically (not bitwise) identical under dropout.
-                if self.dropout > 0.0 and not deterministic:
-                    keep = jax.random.bernoulli(
-                        self.make_rng("dropout"),
-                        1.0 - self.dropout,
-                        (n_t, batch, hidden),
-                    )
-                    mask = keep.astype(self.compute_dtype) / (
-                        1.0 - self.dropout
-                    )
-                else:
-                    # Deterministic / dropout=0: the maskless kernel
-                    # variant — no (T,B,H) mask plane in VMEM at all.
-                    mask = None
-
+                # first layer is never the last). Deterministic / dropout=0
+                # runs the maskless kernel variant — no (T,B,H) mask plane
+                # in VMEM at all.
+                mask = draw_mask()
                 run = lambda xp, w1, wi2, b2, w2, m: lstm_pair_recurrence(
-                    xp, w1, wi2, b2, w2, m, impl=self.kernel_impl
+                    xp, w1, wi2, b2, w2, m, impl=self.kernel_impl,
+                    window_rows=window_rows,
                 )
                 if self.remat:
                     run = jax.checkpoint(run)
@@ -171,7 +232,7 @@ class LstmEncoder(nn.Module):
                 layer += 2
             else:
                 run = lambda xp, wh: lstm_recurrence(
-                    xp, wh, impl=self.kernel_impl
+                    xp, wh, impl=self.kernel_impl, window_rows=window_rows
                 )
                 if self.remat:
                     run = jax.checkpoint(run)

@@ -290,30 +290,66 @@ def _bwd_pallas(interpret, residuals, dhs):
 # scoped-VMEM compile error.
 
 
-def _pair_bwd_vmem_bytes(
-    n_t: int, b_pad: int, hidden: int, has_mask: bool, itemsize: int = 4
+def _stack_bwd_vmem_bytes(
+    n_t: int,
+    b_pad: int,
+    hidden: int,
+    n_layers: int,
+    has_mask: bool,
+    itemsize: int = 4,
 ) -> int:
-    """VMEM footprint of the fused-pair BACKWARD program, in bytes."""
+    """VMEM footprint of an L-layer wavefront BACKWARD program, in bytes.
+
+    ``itemsize`` is the compute dtype's size (4 for f32, 2 for the
+    bf16-mixed mode); gradient-accumulator scratch is always f32. L=2 is
+    exactly the fused-pair program's footprint.
+    """
     four_h = 4 * hidden
-    # (T, B, H) planes: dh2 + h1/c1/h2/c2 stashes (+ optional mask).
-    planes = n_t * b_pad * hidden * (5 + int(has_mask))
+    ell = n_layers
+    # (T, B, H) planes in compute dtype: dh_top + 2L h/c stashes
+    # (+ L-1 optional dropout masks).
+    planes = n_t * b_pad * hidden * (1 + 2 * ell + (ell - 1) * int(has_mask))
     # (T, B, 4H): x1_proj, aliased over the dx1 output (counted once).
     planes += n_t * b_pad * four_h
-    scratch = 5 * b_pad * hidden + 3 * hidden * four_h + four_h
-    weights_in = 3 * hidden * four_h + four_h  # w1t, wi2t, w2t + bias row
-    grads_out = 3 * hidden * four_h + four_h
-    return (planes + scratch + weights_in + grads_out) * itemsize
+    # In/out weight planes in compute dtype: L recurrent + (L-1) input
+    # weights (+ bias rows), each appearing once as input, once as grad.
+    weights = 2 * ((2 * ell - 1) * hidden * four_h + (ell - 1) * four_h)
+    # f32 scratch: per-layer dh/dc + (L-1) seam-cotangent planes, plus the
+    # f32 gradient accumulators for every weight.
+    scratch = (3 * ell - 1) * b_pad * hidden + (
+        (2 * ell - 1) * hidden * four_h + (ell - 1) * four_h
+    )
+    return (planes + weights) * itemsize + scratch * 4
 
 
-_PAIR_VMEM_BUDGET = _pair_bwd_vmem_bytes(60, 104, 64, True)
+_PAIR_VMEM_BUDGET = _stack_bwd_vmem_bytes(60, 104, 64, 2, True, 4)
 
 
-def pair_fits(n_t: int, b: int, hidden: int, has_mask: bool = True) -> bool:
+def stack_fits(
+    n_t: int,
+    b: int,
+    hidden: int,
+    n_layers: int,
+    has_mask: bool = True,
+    itemsize: int = 4,
+) -> bool:
+    """True when an ``n_layers``-deep wavefront over ``b`` rows fits the
+    single-program VMEM budget (the measured-working canonical pair's byte
+    count)."""
+    b_pad = -(-b // 8) * 8
+    return (
+        _stack_bwd_vmem_bytes(n_t, b_pad, hidden, n_layers, has_mask, itemsize)
+        <= _PAIR_VMEM_BUDGET
+    )
+
+
+def pair_fits(
+    n_t: int, b: int, hidden: int, has_mask: bool = True, itemsize: int = 4
+) -> bool:
     """True when a (T=n_t, rows=b, H=hidden) layer pair fits the fused
     single-program kernel's VMEM budget (conservatively assumes the
     dropout-mask plane is present unless told otherwise)."""
-    b_pad = -(-b // 8) * 8
-    return _pair_bwd_vmem_bytes(n_t, b_pad, hidden, has_mask) <= _PAIR_VMEM_BUDGET
+    return stack_fits(n_t, b, hidden, 2, has_mask, itemsize)
 
 
 def pair_rows_ok(b: int, n_t: int = 60, hidden: int = 64) -> bool:
@@ -405,10 +441,13 @@ def _pair_fwd_pallas(x1_proj, mask, w1t, wi2t, b2, w2t, *, interpret):
     n_t, b, four_h = x1_proj.shape
     hidden = four_h // 4
     b_pad = -(-b // 8) * 8
-    if not pair_fits(n_t, b, hidden, has_mask=mask is not None):
+    if not pair_fits(
+        n_t, b, hidden, has_mask=mask is not None,
+        itemsize=jnp.dtype(x1_proj.dtype).itemsize,
+    ):
         raise ValueError(
             f"fused layer pair exceeds the VMEM budget at "
-            f"(T={n_t}, rows={b}, H={hidden})"
+            f"(T={n_t}, rows={b}, H={hidden}, {x1_proj.dtype})"
         )
     x1_padded = _pad_rows(x1_proj, b_pad)
     mask_padded = None if mask is None else _pad_rows(mask, b_pad)
@@ -705,6 +744,452 @@ def lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask=None):
     return lstm_recurrence_xla(x2_proj, w_hh2_t)
 
 
+# --------------------------------------------- L-layer wavefront (stack)
+#
+# The pair kernel's wavefront generalizes: L stacked layers can run as ONE
+# program with layer l at step s-l — L mutually independent recurrent
+# matmuls per loop iteration, a dependent chain of ~T+L instead of
+# (L/2)*(T+2) pair-serialized. What stops arbitrary depth is VMEM: the
+# backward stash grows ~2 (T,B,H) planes (+1 mask) per layer, so at the
+# canonical f32 shape L=2 is the frontier (that is the pair kernel). In the
+# bf16-mixed compute mode every plane halves and a 4-5 deep wavefront fits
+# — this section is what turns that mode from "neutral at bs=1" into the
+# deep-model chain-shortener (cuDNN's multi-layer fused kernel analog;
+# reference: src/model.py:88-94 via torch.nn.LSTM num_layers).
+#
+# Layout conventions (L = n_layers static, bound by closure):
+# - layer 0 consumes x1_proj (projections + both biases, like every kernel
+#   here); layers 1..L-1 project the seam INSIDE the kernel (their h input
+#   never leaves VMEM) from per-seam scratch, exactly like the pair.
+# - masks: L-1 optional dropout planes (torch semantics: every layer's
+#   output except the stack's last gets dropout).
+# - backward recomputes gates from the h/c stashes, aliases dx1 over
+#   x1_proj, and accumulates all weight grads in f32 scratch.
+
+
+def _stack_fwd_kernel(*refs, n_layers, has_mask):
+    ell = n_layers
+    i = 0
+    x1_ref = refs[i]; i += 1
+    masks = refs[i:i + (ell - 1)] if has_mask else ()
+    i += (ell - 1) if has_mask else 0
+    w_hh = refs[i:i + ell]; i += ell
+    w_in = refs[i:i + ell - 1]; i += ell - 1
+    bias = refs[i:i + ell - 1]; i += ell - 1
+    h_out = refs[i:i + ell]; i += ell
+    c_out = refs[i:i + ell]; i += ell
+    h_scr = refs[i:i + ell]; i += ell
+    c_scr = refs[i:i + ell]; i += ell
+    x_scr = refs[i:i + ell - 1]; i += ell - 1
+
+    n_t = x1_ref.shape[0]
+    for scr in (*h_scr, *c_scr):
+        scr[:] = jnp.zeros_like(scr)
+    w = [r[:].astype(jnp.float32) for r in w_hh]
+    wi = [r[:].astype(jnp.float32) for r in w_in]
+    b = [r[:].astype(jnp.float32) for r in bias]
+
+    def body(s, _):
+        # Highest layer first: layer l consumes x_scr[l-1] (written by
+        # layer l-1 at iteration s-1) BEFORE layer l-1 overwrites it below.
+        for layer in reversed(range(ell)):
+
+            @pl.when((s >= layer) & (s < n_t + layer))
+            def _run(layer=layer):
+                t = s - layer
+                if layer == 0:
+                    x_t = x1_ref[t].astype(jnp.float32)
+                else:
+                    x_t = x_scr[layer - 1][:]
+                gates = x_t + lax.dot_general(
+                    h_scr[layer][:], w[layer], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                gi, gf, gg, go = _gate_math(gates)
+                c = gf * c_scr[layer][:] + gi * gg
+                h = go * jnp.tanh(c)
+                h_scr[layer][:] = h
+                c_scr[layer][:] = c
+                h_out[layer][t] = h.astype(h_out[layer].dtype)
+                c_out[layer][t] = c.astype(c_out[layer].dtype)
+                if layer < ell - 1:
+                    seam = (
+                        h * masks[layer][t].astype(jnp.float32)
+                        if has_mask else h
+                    )
+                    x_scr[layer][:] = b[layer] + lax.dot_general(
+                        seam, wi[layer], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+
+        return 0
+
+    lax.fori_loop(0, n_t + ell - 1, body, 0)
+
+
+def _stack_fwd_pallas(x1_proj, masks, w_hh_ts, w_in_ts, biases, *, interpret):
+    """masks: tuple of L-1 ``(T, B, H)`` planes, or None (maskless)."""
+    ell = len(w_hh_ts)
+    n_t, batch, four_h = x1_proj.shape
+    hidden = four_h // 4
+    b_pad = -(-batch // 8) * 8
+    has_mask = masks is not None
+    if not stack_fits(
+        n_t, batch, hidden, ell, has_mask, jnp.dtype(x1_proj.dtype).itemsize
+    ):
+        raise ValueError(
+            f"{ell}-layer wavefront exceeds the VMEM budget at "
+            f"(T={n_t}, rows={batch}, H={hidden}, {x1_proj.dtype})"
+        )
+    x1_padded = _pad_rows(x1_proj, b_pad)
+    masks_padded = (
+        tuple(_pad_rows(m, b_pad) for m in masks) if has_mask else None
+    )
+    bias_rows = tuple(bv.reshape(1, four_h) for bv in biases)
+
+    full_block = lambda width: pl.BlockSpec(  # noqa: E731
+        (n_t, b_pad, width), lambda: (0, 0, 0), memory_space=pltpu.VMEM
+    )
+    weight_block = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda: (0, 0), memory_space=pltpu.VMEM
+    )
+    in_specs = [full_block(four_h)]
+    inputs = [x1_padded]
+    if has_mask:
+        in_specs += [full_block(hidden)] * (ell - 1)
+        inputs += list(masks_padded)
+    in_specs += [weight_block((hidden, four_h))] * ell
+    inputs += list(w_hh_ts)
+    in_specs += [weight_block((hidden, four_h))] * (ell - 1)
+    inputs += list(w_in_ts)
+    in_specs += [weight_block((1, four_h))] * (ell - 1)
+    inputs += list(bias_rows)
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _stack_fwd_kernel, n_layers=ell, has_mask=has_mask
+        ),
+        in_specs=in_specs,
+        out_specs=[full_block(hidden)] * (2 * ell),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_t, b_pad, hidden), x1_proj.dtype)
+        ] * (2 * ell),
+        scratch_shapes=(
+            [pltpu.VMEM((b_pad, hidden), jnp.float32)] * (2 * ell)
+            + [pltpu.VMEM((b_pad, four_h), jnp.float32)] * (ell - 1)
+        ),
+        interpret=interpret,
+    )(*inputs)
+    hs, cs = tuple(outs[:ell]), tuple(outs[ell:])
+    res = (
+        x1_padded, masks_padded, hs, cs,
+        tuple(w_hh_ts), tuple(w_in_ts), bias_rows, batch,
+    )
+    return hs[ell - 1][:, :batch], res
+
+
+def _stack_bwd_kernel(*refs, n_layers, has_mask):
+    ell = n_layers
+    i = 0
+    dh_ref = refs[i]; i += 1
+    x1_ref = refs[i]; i += 1
+    masks = refs[i:i + (ell - 1)] if has_mask else ()
+    i += (ell - 1) if has_mask else 0
+    h_ref = refs[i:i + ell]; i += ell
+    c_ref = refs[i:i + ell]; i += ell
+    w_hh = refs[i:i + ell]; i += ell
+    w_in = refs[i:i + ell - 1]; i += ell - 1
+    bias = refs[i:i + ell - 1]; i += ell - 1
+    dx1_out = refs[i]; i += 1
+    dw_hh_out = refs[i:i + ell]; i += ell
+    dw_in_out = refs[i:i + ell - 1]; i += ell - 1
+    db_out = refs[i:i + ell - 1]; i += ell - 1
+    dh_scr = refs[i:i + ell]; i += ell
+    dc_scr = refs[i:i + ell]; i += ell
+    dh_in_scr = refs[i:i + ell - 1]; i += ell - 1
+    dw_hh_scr = refs[i:i + ell]; i += ell
+    dw_in_scr = refs[i:i + ell - 1]; i += ell - 1
+    db_scr = refs[i:i + ell - 1]; i += ell - 1
+
+    n_t = dh_ref.shape[0]
+    for scr in (*dh_scr, *dc_scr, *dh_in_scr,
+                *dw_hh_scr, *dw_in_scr, *db_scr):
+        scr[:] = jnp.zeros_like(scr)
+    w = [r[:].astype(jnp.float32) for r in w_hh]
+    wi = [r[:].astype(jnp.float32) for r in w_in]
+    b = [r[:].astype(jnp.float32) for r in bias]
+
+    def body(k, _):
+        # Lowest layer first: layer l consumes dh_in_scr[l] (written by
+        # layer l+1 at iteration k-1) BEFORE layer l+1 overwrites it below.
+        for layer in range(ell):
+            lag = ell - 1 - layer  # reverse sweep: top layer leads
+
+            @pl.when((k >= lag) & (k < n_t + lag))
+            def _run(layer=layer, lag=lag):
+                t = n_t - 1 - k + lag
+                t_prev = jnp.maximum(t - 1, 0)
+                not_first = jnp.float32(1.0) - (t == 0).astype(jnp.float32)
+                c_prev = c_ref[layer][t_prev].astype(jnp.float32) * not_first
+                h_prev = h_ref[layer][t_prev].astype(jnp.float32) * not_first
+                if layer == 0:
+                    x_t = x1_ref[t].astype(jnp.float32)
+                    h_below = None
+                else:
+                    h_below = h_ref[layer - 1][t].astype(jnp.float32)
+                    if has_mask:
+                        h_below = h_below * masks[layer - 1][t].astype(
+                            jnp.float32
+                        )
+                    # Recompute the seam projection from the VMEM stash.
+                    x_t = b[layer - 1] + lax.dot_general(
+                        h_below, wi[layer - 1], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                gates = x_t + lax.dot_general(
+                    h_prev, w[layer], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                gi, gf, gg, go = _gate_math(gates)
+                tanh_c = jnp.tanh(c_ref[layer][t].astype(jnp.float32))
+                if layer == ell - 1:
+                    dh_top = dh_ref[t].astype(jnp.float32)
+                else:
+                    dh_top = dh_in_scr[layer][:]
+                dh = dh_top + dh_scr[layer][:]
+                do = dh * tanh_c
+                dc = dh * go * (1.0 - tanh_c * tanh_c) + dc_scr[layer][:]
+                di = dc * gg
+                dg = dc * gi
+                df = dc * c_prev
+                dc_scr[layer][:] = dc * gf
+                d_pre = jnp.concatenate(
+                    [
+                        di * gi * (1.0 - gi),
+                        df * gf * (1.0 - gf),
+                        dg * (1.0 - gg * gg),
+                        do * go * (1.0 - go),
+                    ],
+                    axis=-1,
+                )
+                if layer == 0:
+                    # Slot t of the aliased x1 buffer is dead from here on.
+                    dx1_out[t] = d_pre.astype(dx1_out.dtype)
+                else:
+                    dw_in_scr[layer - 1][:] += lax.dot_general(
+                        h_below, d_pre, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    db_scr[layer - 1][:] += jnp.sum(
+                        d_pre, axis=0, keepdims=True
+                    )
+                    dh_below = lax.dot_general(
+                        d_pre, wi[layer - 1], (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    if has_mask:
+                        dh_below = dh_below * masks[layer - 1][t].astype(
+                            jnp.float32
+                        )
+                    dh_in_scr[layer - 1][:] = dh_below
+                dh_scr[layer][:] = lax.dot_general(
+                    d_pre, w[layer], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                dw_hh_scr[layer][:] += lax.dot_general(
+                    h_prev, d_pre, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+        return 0
+
+    lax.fori_loop(0, n_t + ell - 1, body, 0)
+    for layer in range(ell):
+        dw_hh_out[layer][:] = dw_hh_scr[layer][:].astype(
+            dw_hh_out[layer].dtype
+        )
+    for layer in range(ell - 1):
+        dw_in_out[layer][:] = dw_in_scr[layer][:].astype(
+            dw_in_out[layer].dtype
+        )
+        db_out[layer][:] = db_scr[layer][:].astype(db_out[layer].dtype)
+
+
+def _stack_bwd_pallas(interpret, res, dhs):
+    (x1_padded, masks_padded, hs, cs, w_hh_ts, w_in_ts, bias_rows, batch) = res
+    ell = len(w_hh_ts)
+    n_t, b_pad, four_h = x1_padded.shape
+    hidden = four_h // 4
+    dhs = _pad_rows(dhs, b_pad)
+    has_mask = masks_padded is not None
+
+    full_block = lambda width: pl.BlockSpec(  # noqa: E731
+        (n_t, b_pad, width), lambda: (0, 0, 0), memory_space=pltpu.VMEM
+    )
+    weight_block = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda: (0, 0), memory_space=pltpu.VMEM
+    )
+    in_specs = [full_block(hidden), full_block(four_h)]
+    inputs = [dhs, x1_padded]
+    if has_mask:
+        in_specs += [full_block(hidden)] * (ell - 1)
+        inputs += list(masks_padded)
+    in_specs += [full_block(hidden)] * (2 * ell)
+    inputs += list(hs) + list(cs)
+    in_specs += [weight_block((hidden, four_h))] * (2 * ell - 1)
+    inputs += list(w_hh_ts) + list(w_in_ts)
+    in_specs += [weight_block((1, four_h))] * (ell - 1)
+    inputs += list(bias_rows)
+
+    out_specs = (
+        [full_block(four_h)]
+        + [weight_block((hidden, four_h))] * (2 * ell - 1)
+        + [weight_block((1, four_h))] * (ell - 1)
+    )
+    out_shape = (
+        [jax.ShapeDtypeStruct((n_t, b_pad, four_h), x1_padded.dtype)]
+        + [
+            jax.ShapeDtypeStruct((hidden, four_h), wt.dtype)
+            for wt in (*w_hh_ts, *w_in_ts)
+        ]
+        + [
+            jax.ShapeDtypeStruct((1, four_h), br.dtype)
+            for br in bias_rows
+        ]
+    )
+    scratch_shapes = (
+        [pltpu.VMEM((b_pad, hidden), jnp.float32)] * (3 * ell - 1)
+        + [pltpu.VMEM((hidden, four_h), jnp.float32)] * (2 * ell - 1)
+        + [pltpu.VMEM((1, four_h), jnp.float32)] * (ell - 1)
+    )
+    outs = pl.pallas_call(
+        functools.partial(
+            _stack_bwd_kernel, n_layers=ell, has_mask=has_mask
+        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(*inputs)
+    dx1 = outs[0][:, :batch]
+    dw_hh = tuple(outs[1:1 + ell])
+    dw_in = tuple(outs[1 + ell:2 * ell])
+    db = tuple(o.reshape(four_h) for o in outs[2 * ell:])
+    mask_grads = (
+        tuple(jnp.zeros_like(m[:, :batch]) for m in masks_padded)
+        if has_mask else None
+    )
+    return dx1, (dw_hh, dw_in, db), mask_grads
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _lstm_stack_pallas(x1_proj, weights, masks, interpret=False):
+    """weights = (w_hh_ts tuple[L], w_in_ts tuple[L-1], biases tuple[L-1]);
+    masks = tuple[L-1] of (T, B, H) planes, or None."""
+    h_last, _ = _stack_fwd_pallas(
+        x1_proj, masks, *weights, interpret=interpret
+    )
+    return h_last
+
+
+def _stack_vjp_fwd(x1_proj, weights, masks, interpret):
+    return _stack_fwd_pallas(x1_proj, masks, *weights, interpret=interpret)
+
+
+_lstm_stack_pallas.defvjp(_stack_vjp_fwd, _stack_bwd_pallas)
+
+
+def lstm_stack_xla(x1_proj, weights, masks=None):
+    """Reference formulation of the L-layer stack: chained scans."""
+    w_hh_ts, w_in_ts, biases = weights
+    hs = lstm_recurrence_xla(x1_proj, w_hh_ts[0])
+    for layer in range(1, len(w_hh_ts)):
+        seam = hs if masks is None else hs * masks[layer - 1]
+        x_proj = seam @ w_in_ts[layer - 1] + biases[layer - 1]
+        hs = lstm_recurrence_xla(x_proj, w_hh_ts[layer])
+    return hs
+
+
+def wavefront_enabled() -> bool:
+    """Kill-switch for >2-layer wavefront fusion (MT_LSTM_WAVEFRONT=0).
+
+    Engages only when the stack's byte model fits the VMEM budget — at the
+    canonical f32 shape that caps depth at 2 (the pair), so deep wavefronts
+    are in practice a property of the bf16-mixed compute mode."""
+    return os.environ.get("MT_LSTM_WAVEFRONT", "1") != "0"
+
+
+def lstm_stack_recurrence(
+    x1_proj: jax.Array,
+    weights: tuple,
+    masks: tuple | None = None,
+    impl: str = "auto",
+    window_rows: int | None = None,
+) -> jax.Array:
+    """Run L stacked LSTM layers as one fused wavefront recurrence.
+
+    Args:
+        x1_proj: ``(T, B, 4H)`` time-major layer-1 input projections.
+        weights: ``(w_hh_ts, w_in_ts, biases)`` — tuples of per-layer
+            ``(H, 4H)`` transposed recurrent weights (length L), seam input
+            weights (length L-1), and combined seam biases ``(4H,)``
+            (length L-1).
+        masks: optional tuple of L-1 ``(T, B, H)`` pre-scaled dropout
+            planes for the in-stack seams; ``None`` = maskless variant.
+        impl: ``"pallas"`` | ``"xla"`` | ``"interpret"`` | ``"auto"``.
+        window_rows: rows per window for window-granular scheduling when B
+            exceeds the stack's VMEM budget (see lstm_recurrence).
+
+    Returns:
+        ``(T, B, H)`` top-layer hidden states for every timestep.
+    """
+    w_hh_ts, w_in_ts, biases = (tuple(part) for part in weights)
+    weights = (w_hh_ts, w_in_ts, biases)
+    masks = None if masks is None else tuple(masks)
+    if impl == "auto":
+        impl = (
+            "xla"
+            if os.environ.get("MT_TPU_DISABLE_PALLAS")
+            else ("pallas" if jax.default_backend() == "tpu" else "xla")
+        )
+    ell = len(w_hh_ts)
+    n_t, batch = x1_proj.shape[0], x1_proj.shape[1]
+    hidden = w_hh_ts[0].shape[0]
+    itemsize = jnp.dtype(x1_proj.dtype).itemsize
+    has_mask = masks is not None
+    if impl in ("pallas", "interpret") and not stack_fits(
+        n_t, batch, hidden, ell, has_mask, itemsize
+    ):
+        if window_schedulable(batch, window_rows) and stack_fits(
+            n_t, window_rows, hidden, ell, has_mask, itemsize
+        ):
+            interpret = impl == "interpret"
+            if masks is None:
+                return _map_row_chunks(
+                    lambda xs: _lstm_stack_pallas(
+                        xs[0], weights, None, interpret
+                    ),
+                    batch // window_rows,
+                    x1_proj,
+                )
+            return _map_row_chunks(
+                lambda xs: _lstm_stack_pallas(
+                    xs[0], weights, tuple(xs[1:]), interpret
+                ),
+                batch // window_rows,
+                x1_proj,
+                *masks,
+            )
+        impl = "xla"
+    if impl in ("pallas", "interpret"):
+        return _lstm_stack_pallas(x1_proj, weights, masks, impl == "interpret")
+    if impl == "xla":
+        return lstm_stack_xla(x1_proj, weights, masks)
+    raise ValueError(f"unknown lstm impl: {impl!r}")
+
+
 def lstm_pair_recurrence(
     x1_proj: jax.Array,
     w_hh1_t: jax.Array,
@@ -713,6 +1198,7 @@ def lstm_pair_recurrence(
     w_hh2_t: jax.Array,
     mask: jax.Array | None = None,
     impl: str = "auto",
+    window_rows: int | None = None,
 ) -> jax.Array:
     """Run TWO stacked LSTM layers as one fused wavefront recurrence.
 
@@ -728,6 +1214,10 @@ def lstm_pair_recurrence(
             layer-2 projection. ``None`` (deterministic / dropout=0) runs
             the maskless kernel variant — no mask plane in VMEM.
         impl: ``"pallas"`` | ``"xla"`` | ``"interpret"`` | ``"auto"``.
+        window_rows: rows per window when B is a flattened window stack;
+            batches past the pair's VMEM budget are then scheduled
+            window-per-program (fused kernel kept) instead of degrading to
+            the scan formulation.
 
     Returns:
         ``(T, B, H)`` layer-2 hidden states for every timestep.
@@ -738,10 +1228,33 @@ def lstm_pair_recurrence(
             if os.environ.get("MT_TPU_DISABLE_PALLAS")
             else ("pallas" if jax.default_backend() == "tpu" else "xla")
         )
+    n_t, b = x1_proj.shape[0], x1_proj.shape[1]
+    hidden = w_hh1_t.shape[0]
+    has_mask = mask is not None
+    itemsize = jnp.dtype(x1_proj.dtype).itemsize
     if impl in ("pallas", "interpret") and not pair_fits(
-        x1_proj.shape[0], x1_proj.shape[1], w_hh1_t.shape[0],
-        has_mask=mask is not None,
+        n_t, b, hidden, has_mask=has_mask, itemsize=itemsize
     ):
+        if window_schedulable(b, window_rows) and pair_fits(
+            n_t, window_rows, hidden, has_mask=has_mask, itemsize=itemsize
+        ):
+            interpret = impl == "interpret"
+            if mask is None:
+                return _map_row_chunks(
+                    lambda xs: _lstm_pair_pallas_nomask(
+                        xs[0], w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret
+                    ),
+                    b // window_rows,
+                    x1_proj,
+                )
+            return _map_row_chunks(
+                lambda xs: _lstm_pair_pallas(
+                    xs[0], w_hh1_t, w_ih2_t, bias2, w_hh2_t, xs[1], interpret
+                ),
+                b // window_rows,
+                x1_proj,
+                mask,
+            )
         impl = "xla"  # residual stash would not fit one VMEM program
     if impl in ("pallas", "interpret"):
         interpret = impl == "interpret"
@@ -755,6 +1268,50 @@ def lstm_pair_recurrence(
     if impl == "xla":
         return lstm_pair_xla(x1_proj, w_hh1_t, w_ih2_t, bias2, w_hh2_t, mask)
     raise ValueError(f"unknown lstm impl: {impl!r}")
+
+
+# ------------------------------------------- window-granular row scheduling
+#
+# Batched training flattens (B windows x K stocks) into B*K rows, and past
+# ~104 rows the kernels above fall off the single-program path onto a 32-row
+# tiled grid whose per-step matmuls are 3x further below MXU tile efficiency
+# — RESULTS.md's measured bs>1 throughput cliff. But the rows of a batch are
+# not anonymous: they come in K-row windows, and ONE window is exactly the
+# shape the single-program path already runs best (the reference's cuDNN
+# LSTM batches flat because its kernel tiles internally; reference:
+# src/model.py:88-94). A Pallas grid executes sequentially on the core
+# anyway, so scheduling the batch as a ``lax.map`` over windows — each
+# iteration one single-program kernel at the window's own row count — keeps
+# every recurrent matmul at the ~104-row MXU shape and recovers flat
+# per-window cost. Callers that know the window size (the train/eval steps
+# flatten it themselves) pass ``window_rows``; without it behavior is
+# unchanged.
+
+
+def _map_row_chunks(fn, n_chunks: int, *arrays):
+    """Run ``fn`` over ``n_chunks`` equal row-chunks of time-major arrays.
+
+    Each array is ``(T, B, X)``; ``fn`` receives one ``(T, B/n, X)`` chunk
+    per array and returns ``(T, B/n, H)``; chunks are restitched to
+    ``(T, B, H)``. ``lax.map`` keeps the chunk programs sequential — the
+    recurrence is latency-bound, so there is no parallelism to lose."""
+    t = arrays[0].shape[0]
+    b = arrays[0].shape[1]
+    win = b // n_chunks
+    chunked = tuple(
+        a.reshape(t, n_chunks, win, a.shape[2]).swapaxes(0, 1)
+        for a in arrays
+    )
+    out = lax.map(fn, chunked)
+    return out.swapaxes(0, 1).reshape(t, b, out.shape[-1])
+
+
+def window_schedulable(b: int, window_rows: int | None) -> bool:
+    return (
+        window_rows is not None
+        and 0 < window_rows < b
+        and b % window_rows == 0
+    )
 
 
 # -------------------------------------------------------------- public API
@@ -794,7 +1351,10 @@ def lstm_recurrence_xla(x_proj: jax.Array, w_hh_t: jax.Array) -> jax.Array:
 
 
 def lstm_recurrence(
-    x_proj: jax.Array, w_hh_t: jax.Array, impl: str = "auto"
+    x_proj: jax.Array,
+    w_hh_t: jax.Array,
+    impl: str = "auto",
+    window_rows: int | None = None,
 ) -> jax.Array:
     """Run the LSTM time recurrence over pre-projected inputs.
 
@@ -804,6 +1364,10 @@ def lstm_recurrence(
         w_hh_t: ``(H, 4H)`` transposed recurrent weight.
         impl: ``"pallas"`` | ``"xla"`` | ``"interpret"`` | ``"auto"``
             (pallas on TPU, xla elsewhere).
+        window_rows: rows per window when the B axis is a flattened stack
+            of independent windows; batches past the single-program limit
+            are then scheduled window-per-program instead of falling onto
+            the 32-row tiled grid (see the window-granular section above).
 
     Returns:
         ``(T, B, H)`` hidden states for every timestep.
@@ -814,10 +1378,20 @@ def lstm_recurrence(
             if os.environ.get("MT_TPU_DISABLE_PALLAS")
             else ("pallas" if jax.default_backend() == "tpu" else "xla")
         )
-    if impl == "pallas":
-        return _lstm_recurrence_pallas(x_proj, w_hh_t, False)
-    if impl == "interpret":
-        return _lstm_recurrence_pallas(x_proj, w_hh_t, True)
+    if impl in ("pallas", "interpret"):
+        interpret = impl == "interpret"
+        b = x_proj.shape[1]
+        if (
+            -(-b // 8) * 8 > SINGLE_TILE_MAX_ROWS
+            and window_schedulable(b, window_rows)
+            and -(-window_rows // 8) * 8 <= SINGLE_TILE_MAX_ROWS
+        ):
+            return _map_row_chunks(
+                lambda xs: _lstm_recurrence_pallas(xs[0], w_hh_t, interpret),
+                b // window_rows,
+                x_proj,
+            )
+        return _lstm_recurrence_pallas(x_proj, w_hh_t, interpret)
     if impl == "xla":
         return lstm_recurrence_xla(x_proj, w_hh_t)
     raise ValueError(f"unknown lstm impl: {impl!r}")

@@ -48,8 +48,13 @@ def forward_rows(module, params, x, dropout_rng=None):
     rows = x.reshape(b * k, *x.shape[2:])
     deterministic = dropout_rng is None
     rngs = None if deterministic else {"dropout": dropout_rng}
+    # window_rows=k tells the recurrence where the window boundaries are in
+    # the flattened row axis, so bs>1 batches schedule window-per-Pallas-
+    # program instead of falling onto the row-tiled grid (the bs>1
+    # throughput cliff, RESULTS.md).
     alpha, beta = module.apply(
-        {"params": params}, rows, deterministic=deterministic, rngs=rngs
+        {"params": params}, rows, deterministic=deterministic, rngs=rngs,
+        window_rows=k,
     )
     return alpha.reshape(b, k, 1), beta.reshape(b, k, 1)
 

@@ -1,13 +1,23 @@
 #!/usr/bin/env python
-"""A/B micro-bench: fused layer-pair Pallas kernel vs the per-layer path.
+"""A/B micro-bench: wavefront fusion modes vs the per-layer kernel path.
 
 Measures canonical-workload train-step throughput (100-stock windows,
-batch_size=1, model=small -> 2 layers, and model=medium -> 4 layers) with
-MT_LSTM_FUSED_PAIR=0 and =1. Each point runs in a subprocess so the env
-switch cannot leak across jit traces.
+batch_size=1) for model=small (2 layers), medium (4), large (8) across:
 
-Usage: python sweeps/bench_fused_pair.py            # orchestrate A/B
-       python sweeps/bench_fused_pair.py --child 1 small   # one point
+- ``perlayer``:        MT_LSTM_FUSED_PAIR=0 (f32) — no fusion
+- ``pair``:            fused layer pairs, f32 (the round-3 default)
+- ``pair_bf16``:       fused pairs under precision=bf16-mixed (control:
+                       isolates the dtype effect from the fusion effect)
+- ``wavefront_bf16``:  deep wavefront under bf16-mixed — at the canonical
+                       shape the VMEM byte model admits 4-layer groups, so
+                       medium runs as ONE program and large as two
+                       (ops/lstm_kernel.py, stack section)
+
+Each point runs in a subprocess so env switches cannot leak across jit
+traces.
+
+Usage: python sweeps/bench_fused_pair.py                    # orchestrate
+       python sweeps/bench_fused_pair.py --child pair small # one point
 """
 
 from __future__ import annotations
@@ -21,11 +31,33 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-MODEL_LAYERS = {"small": 2, "medium": 4}
+MODEL_LAYERS = {"small": 2, "medium": 4, "large": 8}
+
+MODES = {
+    "perlayer": {"MT_LSTM_FUSED_PAIR": "0", "precision": "32-true"},
+    "pair": {
+        "MT_LSTM_FUSED_PAIR": "1",
+        "MT_LSTM_WAVEFRONT": "0",
+        "precision": "32-true",
+    },
+    "pair_bf16": {
+        "MT_LSTM_FUSED_PAIR": "1",
+        "MT_LSTM_WAVEFRONT": "0",
+        "precision": "bf16-mixed",
+    },
+    "wavefront_bf16": {
+        "MT_LSTM_FUSED_PAIR": "1",
+        "MT_LSTM_WAVEFRONT": "1",
+        "precision": "bf16-mixed",
+    },
+}
 
 
-def child(fused: str, model: str) -> None:
-    os.environ["MT_LSTM_FUSED_PAIR"] = fused
+def child(mode: str, model: str) -> None:
+    cfg = MODES[mode]
+    for key in ("MT_LSTM_FUSED_PAIR", "MT_LSTM_WAVEFRONT"):
+        if key in cfg:
+            os.environ[key] = cfg[key]
     sys.path.insert(0, str(REPO))
     from masters_thesis_tpu.data.pipeline import (
         FinancialWindowDataModule,
@@ -51,39 +83,46 @@ def child(fused: str, model: str) -> None:
         max_epochs=7,  # epoch 0 absorbs compile
         gradient_clip_val=5.0,
         check_val_every_n_epoch=10_000,
+        precision=cfg["precision"],
         enable_progress_bar=False,
         enable_model_summary=False,
         seed=0,
     )
     result = trainer.fit(spec, dm)
     print(json.dumps({
-        "fused": fused, "model": model,
+        "mode": mode, "model": model,
         "steps_per_sec": round(result.steps_per_sec, 2),
     }))
 
 
 def main() -> None:
+    models = sys.argv[1:] or list(MODEL_LAYERS)
     rows = []
-    for model in MODEL_LAYERS:
-        for fused in ("0", "1"):
+    for model in models:
+        for mode in MODES:
             t0 = time.time()
             out = subprocess.run(
-                [sys.executable, __file__, "--child", fused, model],
+                [sys.executable, __file__, "--child", mode, model],
                 cwd=REPO, timeout=900, capture_output=True, text=True,
             )
             if out.returncode != 0:
-                print(f"[{model} fused={fused}] FAILED:\n{out.stderr[-2000:]}")
+                print(f"[{model} {mode}] FAILED:\n{out.stderr[-2000:]}")
                 continue
             row = json.loads(out.stdout.strip().splitlines()[-1])
             row["wall_s"] = round(time.time() - t0, 1)
             rows.append(row)
             print(json.dumps(row), flush=True)
-    by = {(r["model"], r["fused"]): r["steps_per_sec"] for r in rows}
-    for model in MODEL_LAYERS:
-        a, b = by.get((model, "0")), by.get((model, "1"))
-        if a and b:
-            print(f"{model}: unfused {a} -> fused {b} steps/s "
-                  f"({b / a:.2f}x)")
+    by = {(r["model"], r["mode"]): r["steps_per_sec"] for r in rows}
+    for model in models:
+        base = by.get((model, "perlayer"))
+        if not base:
+            continue
+        parts = [f"{model}: perlayer {base}"]
+        for mode in ("pair", "pair_bf16", "wavefront_bf16"):
+            v = by.get((model, mode))
+            if v:
+                parts.append(f"{mode} {v} ({v / base:.2f}x)")
+        print(" | ".join(parts))
 
 
 if __name__ == "__main__":

@@ -124,6 +124,7 @@ def _write_real_raw_fixtures(raw_dir, n_days=420, seed=0):
     (raw_dir / FF.p25_filename).write_text("\n".join(p25_lines) + "\n")
 
 
+@pytest.mark.slow
 def test_real_datamodule_cli_end_to_end(tmp_path, capsys):
     """`train.py datamodule=real` -> `test.py` through the CLI on
     reference-format fixture CSVs: bootstrap (CSV -> arrays), training,
@@ -182,6 +183,7 @@ def test_eval_cli_without_checkpoint_exits_cleanly(cli_run, capsys):
     assert "No model checkpoint found" in capsys.readouterr().err
 
 
+@pytest.mark.slow
 def test_sigkill_mid_training_then_cli_resume(tmp_path):
     """Elastic recovery, for real: SIGKILL a training PROCESS mid-run, then
     re-invoke the same CLI command with trainer.resume=true and finish.
@@ -350,6 +352,7 @@ def test_multirun_numbered_job_dirs(tmp_path, capsys, monkeypatch):
         assert (versions[0] / "checkpoints" / "best").exists()
 
 
+@pytest.mark.slow
 def test_multirun_parallel_launcher_numbered_dirs(tmp_path, capsys, monkeypatch):
     """launcher=joblib worker processes also write the numbered Hydra-style
     job dirs when save_dir is relative (the sweep_dir plumbing survives

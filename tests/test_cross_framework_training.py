@@ -14,6 +14,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # epoch-scale torch-vs-jax fits, ~2 min
+
 from masters_thesis_tpu.data.pipeline import Batch
 from masters_thesis_tpu.models.objectives import ModelSpec
 from masters_thesis_tpu.parallel import make_data_mesh

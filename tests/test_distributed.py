@@ -25,6 +25,9 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # 2-process rendezvous runs ~3 min
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent
 _WORKER = Path(__file__).resolve().parent / "_distributed_worker.py"

@@ -153,6 +153,7 @@ def test_gradients_flow_through_all_layers():
         assert np.any(g != 0)
 
 
+@pytest.mark.slow
 def test_remat_gradients_match_plain():
     """jax.checkpoint over the recurrence must not change gradients — only
     the backward's memory/recompute schedule (the long-lookback knob)."""

@@ -81,6 +81,7 @@ def test_batched_mse_equals_flattened_mse():
     np.testing.assert_allclose(float(loss), flat, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_objective_differentiable_through_model():
     spec = ModelSpec(objective="combined", hidden_size=8, num_layers=2)
     model = spec.build_module()

@@ -18,6 +18,7 @@ from masters_thesis_tpu.ops.lstm_kernel import (
     lstm_recurrence_xla,
     pair_fits,
     pair_rows_ok,
+    stack_fits,
 )
 
 
@@ -202,6 +203,255 @@ def test_pair_large_rows_falls_back_to_xla(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_window_scheduled_forward_parity(rng):
+    """Rows past the single-program limit with a known window size run
+    window-per-program (lax.map of the fast path) and must match the scan
+    formulation exactly — fwd and bwd (the bs>1 cliff fix, RESULTS.md)."""
+    n_t, win, n_win, hidden = 6, 50, 3, 16
+    b = win * n_win  # 150 > SINGLE_TILE_MAX_ROWS
+    x_proj, w_hh_t = _random_case(rng, n_t, b, hidden)
+    ref = lstm_recurrence_xla(x_proj, w_hh_t)
+    out = lstm_recurrence(x_proj, w_hh_t, impl="interpret", window_rows=win)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    w_out = jnp.asarray(rng.normal(size=(n_t, b, hidden)), jnp.float32)
+
+    def loss(fn):
+        return lambda xp, w: jnp.sum(fn(xp, w) * w_out)
+
+    g_ref = jax.grad(loss(lstm_recurrence_xla), argnums=(0, 1))(x_proj, w_hh_t)
+    g_win = jax.grad(
+        loss(lambda xp, w: lstm_recurrence(
+            xp, w, impl="interpret", window_rows=win
+        )),
+        argnums=(0, 1),
+    )(x_proj, w_hh_t)
+    np.testing.assert_allclose(np.asarray(g_win[0]), np.asarray(g_ref[0]),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(g_win[1]), np.asarray(g_ref[1]),
+                               atol=2e-4 * max(1, b // 16))
+
+
+@pytest.mark.parametrize("dropout", [None, 0.3])
+@pytest.mark.slow
+def test_window_scheduled_pair_parity(rng, dropout):
+    """The fused pair keeps fusing past its VMEM budget when the batch is a
+    stack of windows that each fit — one pair program per window."""
+    n_t, win, n_win, hidden = 30, 80, 3, 64
+    b = win * n_win  # 240 rows exceeds the pair budget; 80-row windows fit
+    assert not pair_fits(n_t, b, hidden, dropout is not None)
+    assert pair_fits(n_t, win, hidden, dropout is not None)
+    args = _random_pair_case(rng, n_t, b, hidden, dropout=dropout)
+    ref = lstm_pair_xla(*args)
+    out = lstm_pair_recurrence(*args, impl="interpret", window_rows=win)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    w_out = jnp.asarray(rng.normal(size=(n_t, b, hidden)), jnp.float32)
+
+    def loss(fn):
+        def inner(x1, w1, wi2, b2, w2):
+            return jnp.sum(fn(x1, w1, wi2, b2, w2, args[5]) * w_out)
+
+        return inner
+
+    ref_fn = loss(lstm_pair_xla)
+    win_fn = loss(
+        lambda *a: lstm_pair_recurrence(*a, impl="interpret", window_rows=win)
+    )
+    grads_ref = jax.grad(ref_fn, argnums=(0, 1, 2, 3, 4))(*args[:5])
+    grads_win = jax.grad(win_fn, argnums=(0, 1, 2, 3, 4))(*args[:5])
+    for name, g_w, g_r in zip(
+        ("dx1", "dw_hh1", "dw_ih2", "db2", "dw_hh2"), grads_win, grads_ref
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g_w), np.asarray(g_r),
+            atol=2e-4 * max(1, b // 16), err_msg=name,
+        )
+
+
+def test_window_scheduled_pair_over_budget_shape(rng):
+    """A canonical-geometry batch (T=60, H=64) over the pair budget but
+    made of in-budget windows must still produce xla-parity output through
+    the window-scheduled fused path."""
+    args = _random_pair_case(rng, 60, 200, 64, dropout=None)
+    assert not pair_fits(60, 200, 64, False)
+    assert pair_fits(60, 100, 64, False)
+    out = lstm_pair_recurrence(*args, impl="interpret", window_rows=100)
+    ref = lstm_pair_xla(*args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_encoder_window_rows_matches_flat(rng):
+    """Encoder outputs must be IDENTICAL with and without the window_rows
+    hint (deterministic mode) — scheduling must never change numerics."""
+    from masters_thesis_tpu.models.lstm import LstmEncoder
+
+    x = jnp.asarray(rng.normal(size=(150, 12, 3)), jnp.float32)
+    enc = LstmEncoder(hidden_size=16, num_layers=2, kernel_impl="interpret")
+    params = enc.init(jax.random.key(0), x)["params"]
+    a_flat, b_flat = enc.apply({"params": params}, x)
+    a_win, b_win = enc.apply({"params": params}, x, window_rows=50)
+    np.testing.assert_allclose(np.asarray(a_win), np.asarray(a_flat),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(b_win), np.asarray(b_flat),
+                               atol=1e-5)
+
+
+def _random_stack_case(rng, n_t, b, hidden, n_layers, *, dropout=None):
+    x1 = jnp.asarray(rng.normal(size=(n_t, b, 4 * hidden)), jnp.float32)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32
+    )
+    w_hh = tuple(mk() for _ in range(n_layers))
+    w_in = tuple(mk() for _ in range(n_layers - 1))
+    bias = tuple(
+        jnp.asarray(rng.normal(size=(4 * hidden,)) * 0.1, jnp.float32)
+        for _ in range(n_layers - 1)
+    )
+    if dropout is None:
+        masks = None
+    else:
+        masks = tuple(
+            jnp.asarray(
+                (rng.random(size=(n_t, b, hidden)) > dropout)
+                / (1.0 - dropout),
+                jnp.float32,
+            )
+            for _ in range(n_layers - 1)
+        )
+    return x1, (w_hh, w_in, bias), masks
+
+
+@pytest.mark.parametrize(
+    "n_t,b,hidden,n_layers,dropout",
+    [
+        (5, 4, 8, 3, None),
+        (5, 4, 8, 3, 0.3),
+        (6, 12, 8, 4, None),
+        (6, 12, 8, 4, 0.2),
+        (4, 13, 8, 5, None),  # row padding + depth 5
+    ],
+)
+@pytest.mark.slow
+def test_stack_forward_and_gradient_parity(rng, n_t, b, hidden, n_layers,
+                                           dropout):
+    """L-layer wavefront vs chained scans: fwd and all weight grads."""
+    from masters_thesis_tpu.ops.lstm_kernel import (
+        lstm_stack_recurrence,
+        lstm_stack_xla,
+    )
+
+    x1, weights, masks = _random_stack_case(
+        rng, n_t, b, hidden, n_layers, dropout=dropout
+    )
+    ref = lstm_stack_xla(x1, weights, masks)
+    out = lstm_stack_recurrence(x1, weights, masks, impl="interpret")
+    assert out.shape == (n_t, b, hidden)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    w_out = jnp.asarray(rng.normal(size=(n_t, b, hidden)), jnp.float32)
+
+    def loss(fn):
+        return lambda xp, w: jnp.sum(fn(xp, w, masks) * w_out)
+
+    g_ref = jax.grad(loss(lstm_stack_xla), argnums=(0, 1))(x1, weights)
+    g_pl = jax.grad(
+        loss(lambda xp, w, m: lstm_stack_recurrence(
+            xp, w, m, impl="interpret"
+        )),
+        argnums=(0, 1),
+    )(x1, weights)
+    for g_p, g_r in zip(
+        jax.tree_util.tree_leaves(g_pl), jax.tree_util.tree_leaves(g_ref)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g_p), np.asarray(g_r), atol=5e-4
+        )
+
+
+def test_stack_fits_depth_frontier():
+    """The byte model's depth frontier at the canonical shape: f32 caps at
+    the pair; bf16 (itemsize 2) unlocks the 4-deep wavefront (model=medium
+    in one program)."""
+    assert stack_fits(60, 104, 64, 2, True, 4)       # the pair (f32)
+    assert not stack_fits(60, 104, 64, 3, True, 4)   # f32 depth 3: over
+    assert stack_fits(60, 104, 64, 4, True, 2)       # bf16 medium: fits
+    assert not stack_fits(60, 104, 64, 5, True, 2)   # bf16 depth 5: over
+    assert stack_fits(60, 104, 64, 4, False, 2)      # bf16 eval: fits too
+    # L=2 must agree with the pair model exactly.
+    assert pair_fits(60, 104, 64, True) == stack_fits(60, 104, 64, 2, True)
+    assert pair_fits(60, 112, 64, False) == stack_fits(60, 112, 64, 2, False)
+
+
+def test_stack_window_scheduled_parity(rng):
+    """Stack over-budget batches made of in-budget windows keep the fused
+    wavefront via window-per-program scheduling."""
+    from masters_thesis_tpu.ops.lstm_kernel import (
+        lstm_stack_recurrence,
+        lstm_stack_xla,
+    )
+
+    n_t, win, n_win, hidden, ell = 30, 64, 3, 64, 3
+    b = win * n_win
+    assert not stack_fits(n_t, b, hidden, ell, False, 4)
+    assert stack_fits(n_t, win, hidden, ell, False, 4)
+    x1, weights, masks = _random_stack_case(rng, n_t, b, hidden, ell)
+    out = lstm_stack_recurrence(
+        x1, weights, masks, impl="interpret", window_rows=win
+    )
+    ref = lstm_stack_xla(x1, weights, masks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_encoder_deep_wavefront_matches_per_layer(rng, monkeypatch):
+    """Full encoder, deterministic mode: the deep-wavefront grouping must
+    agree with both the per-layer path and the pair grouping for depths
+    where it engages (small f32 shapes fit depth 3-4 here)."""
+    from masters_thesis_tpu.models.lstm import LstmEncoder
+
+    x = jnp.asarray(rng.normal(size=(9, 12, 3)), jnp.float32)
+    for layers in (3, 4, 5):
+        enc = LstmEncoder(hidden_size=16, num_layers=layers)
+        params = enc.init(jax.random.key(0), x)["params"]
+        a_ref, b_ref = LstmEncoder(
+            hidden_size=16, num_layers=layers, kernel_impl="xla"
+        ).apply({"params": params}, x)
+        # Wavefront ON (default): deep grouping through the stack kernel.
+        monkeypatch.delenv("MT_LSTM_WAVEFRONT", raising=False)
+        a_wf, b_wf = LstmEncoder(
+            hidden_size=16, num_layers=layers, kernel_impl="interpret"
+        ).apply({"params": params}, x)
+        # Wavefront OFF: falls back to the pair grouping.
+        monkeypatch.setenv("MT_LSTM_WAVEFRONT", "0")
+        a_pair, b_pair = LstmEncoder(
+            hidden_size=16, num_layers=layers, kernel_impl="interpret"
+        ).apply({"params": params}, x)
+        monkeypatch.delenv("MT_LSTM_WAVEFRONT", raising=False)
+        for got, want in ((a_wf, a_ref), (b_wf, b_ref),
+                          (a_pair, a_ref), (b_pair, b_ref)):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-5
+            )
+
+
+def test_encoder_bf16_deep_wavefront_close_to_f32(rng):
+    """bf16 compute engages the deep wavefront at shapes f32 cannot fit;
+    outputs must stay within bf16 tolerance of the f32 per-layer path."""
+    from masters_thesis_tpu.models.lstm import LstmEncoder
+
+    x = jnp.asarray(rng.normal(size=(32, 20, 3)), jnp.float32)
+    enc_f32 = LstmEncoder(hidden_size=16, num_layers=4, kernel_impl="xla")
+    params = enc_f32.init(jax.random.key(0), x)["params"]
+    a32, b32 = enc_f32.apply({"params": params}, x)
+    a16, b16 = LstmEncoder(
+        hidden_size=16, num_layers=4, kernel_impl="interpret",
+        compute_dtype=jnp.bfloat16,
+    ).apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(a16), np.asarray(a32), atol=0.05)
+    np.testing.assert_allclose(np.asarray(b16), np.asarray(b32), atol=0.05)
+
+
+@pytest.mark.slow
 def test_encoder_fused_pair_matches_unfused(rng, monkeypatch):
     """Full encoder, deterministic mode: fused-pair and per-layer paths
     must agree for every depth (2 = one pair, 3 = pair + tail, 4 = two
@@ -228,6 +478,7 @@ def test_encoder_fused_pair_matches_unfused(rng, monkeypatch):
         )
 
 
+@pytest.mark.slow
 def test_encoder_fused_pair_gradients(rng, monkeypatch):
     """Fused-path encoder gradients match the per-layer path (no dropout)."""
     from masters_thesis_tpu.models.lstm import LstmEncoder

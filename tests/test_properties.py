@@ -7,6 +7,7 @@ covariance of the OLS fit).
 """
 
 import numpy as np
+import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
@@ -33,6 +34,7 @@ def window_params(draw):
 
 @given(window_params())
 @SET
+@pytest.mark.slow
 def test_window_split_invariants(params):
     k, n_samples, look, tgt, stride = params
     rng = np.random.default_rng(0)
@@ -128,6 +130,7 @@ def pair_case(draw):
 
 @given(pair_case())
 @settings(max_examples=10, deadline=None)
+@pytest.mark.slow
 def test_pair_kernel_matches_scan_for_any_shape(case):
     """LAW: for every (T, B, H, mask) the fused wavefront Pallas program
     (interpreter mode) computes the same outputs AND gradients as the
