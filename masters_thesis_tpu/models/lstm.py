@@ -69,10 +69,14 @@ class LstmEncoder(nn.Module):
     # recurrence VJP's per-step h/c residual stash is recomputed instead of
     # stored — a constant-factor (~2-3x) activation-memory saving per layer
     # (each layer's (T, B, 4H) x_proj input is still saved as the remat
-    # residual) at ~1.3x backward FLOPs. This is the long-lookback knob:
-    # there is no ring-attention analog here — the LSTM recurrence is
-    # inherently sequential, so long sequences scale by remat + the
-    # VMEM-resident time loop, not by sequence sharding.
+    # residual) at ~1.3x backward FLOPs. Long-lookback story: there is no
+    # ring-attention analog here — the LSTM recurrence is inherently
+    # sequential, so long sequences cannot shard over devices; they STREAM
+    # through VMEM instead. Lookbacks whose planes exceed the VMEM budget
+    # automatically take the time-blocked kernel (grid over time chunks,
+    # h/c carried in scratch across sequential grid steps;
+    # ops/lstm_kernel.py time-blocked section), and remat bounds the
+    # HBM-side activation footprint on top.
     remat: bool = False
 
     @nn.compact

@@ -64,6 +64,35 @@ SINGLE_TILE_MAX_ROWS = 104
 ROW_TILE = 32
 
 
+def single_layer_fits(
+    n_t: int, b: int, hidden: int, itemsize: int = 4
+) -> bool:
+    """VMEM feasibility of the single-layer kernel at (T, rows, H).
+
+    The backward program is the high-water mark: per row-tile it holds the
+    x/dx aliased ``(T, tile, 4H)`` plane, the dh cotangent and h/c stashes
+    (3 ``(T, tile, H)`` planes), the weight and its grad, and the f32
+    scratch — doubled when the row grid pipelines more than one tile
+    (Pallas double-buffers blocked refs across grid steps). Long lookbacks
+    blow this budget no matter the row tile; callers must fall back to the
+    time-blocked kernel or the scan formulation instead of hitting a
+    Mosaic scoped-VMEM compile error.
+    """
+    four_h = 4 * hidden
+    tile = _row_tile(b)
+    b_pad = -(-b // 8) * 8
+    if b_pad <= tile:
+        # Single program: dx aliases over x (one 4H plane), no pipelining.
+        planes = n_t * tile * (four_h + 3 * hidden)
+    else:
+        # Row grid: _bwd_pallas disables the dx alias (separate x and dx
+        # planes) and the grid pipeline double-buffers every blocked ref.
+        planes = n_t * tile * (2 * four_h + 3 * hidden) * 2
+    scratch = 2 * tile * hidden + hidden * four_h
+    weights = 2 * hidden * four_h
+    return (planes + weights) * itemsize + scratch * 4 <= _PAIR_VMEM_BUDGET
+
+
 def _fallback_row_tile() -> int:
     raw = os.environ.get("MT_LSTM_ROW_TILE", str(ROW_TILE))
     try:
@@ -262,6 +291,244 @@ def _bwd_pallas(interpret, residuals, dhs):
         interpret=interpret,
     )(dhs, x_padded, hs, cs, w_hh_t)
     return dx[:, :b], jnp.sum(dw_partial, axis=0)
+
+
+# ------------------------------------------ time-blocked long-lookback path
+#
+# The kernels above keep every (T, tile, ...) plane VMEM-resident for the
+# whole time loop — the right call at the reference's T=60, but a long
+# lookback override (the reference exposes datamodule.lookback_window
+# freely) scales those planes linearly in T past the ~16 MB budget at ANY
+# row tile. This is the framework's long-context mechanism (SURVEY.md §5:
+# the LSTM recurrence is inherently serial, so long sequences cannot shard
+# over devices the way attention rings do — they must stream through VMEM):
+# a 2-D grid over (row tiles, time chunks) where the hidden/cell carry
+# lives in scratch ACROSS sequential grid steps (Pallas TPU grids execute
+# in order, innermost axis fastest), so VMEM holds one time chunk at a
+# time while the recurrence itself never leaves the chip. The backward
+# sweep runs the time-chunk axis REVERSED via the index maps, consumes
+# pre-shifted h/c stashes (so no cross-chunk reads), accumulates dw in
+# scratch, and aliases dx over the x chunks like the resident kernel.
+
+
+def _tb_time_chunk(tile: int, hidden: int, itemsize: int) -> int:
+    """Largest time-chunk whose backward block set fits the VMEM budget."""
+    four_h = 4 * hidden
+    fixed = (
+        (2 * tile * hidden + hidden * four_h) * 4  # f32 carries + dw scratch
+        + 2 * hidden * four_h * itemsize           # w in + dw partial out
+        + 2 * 2 * tile * hidden * itemsize         # h/c chunk-boundary blocks
+    )
+    # Double-buffered blocked planes per time step: x and dx (4H each — no
+    # aliasing under a multi-program grid) + dh, h, c (H each).
+    per_step = 2 * itemsize * tile * (2 * four_h + 3 * hidden)
+    return max(1, (_PAIR_VMEM_BUDGET - fixed) // per_step)
+
+
+def _tb_fwd_kernel(x_ref, w_ref, h_out, c_out, h_scr, c_scr):
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+        c_scr[:] = jnp.zeros_like(c_scr)
+
+    w = w_ref[:].astype(jnp.float32)
+
+    def body(k, _):
+        gates = x_ref[k].astype(jnp.float32) + lax.dot_general(
+            h_scr[:], w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        gi, gf, gg, go = _gate_math(gates)
+        c = gf * c_scr[:] + gi * gg
+        h = go * jnp.tanh(c)
+        h_scr[:] = h
+        c_scr[:] = c
+        h_out[k] = h.astype(h_out.dtype)
+        c_out[k] = c.astype(c_out.dtype)
+        return 0
+
+    lax.fori_loop(0, x_ref.shape[0], body, 0)
+
+
+def _tb_fwd_pallas(x_proj, w_hh_t, *, interpret):
+    n_t, b, four_h = x_proj.shape
+    hidden = four_h // 4
+    tile = _row_tile(b)
+    b_pad = -(-b // tile) * tile
+    itemsize = jnp.dtype(x_proj.dtype).itemsize
+    tc = min(_tb_time_chunk(tile, hidden, itemsize), n_t)
+    t_pad = -(-n_t // tc) * tc
+    x_padded = jnp.pad(
+        _pad_rows(x_proj, b_pad), ((0, t_pad - n_t), (0, 0), (0, 0))
+    )
+    grid = (b_pad // tile, t_pad // tc)
+
+    tblock = lambda width: pl.BlockSpec(  # noqa: E731
+        (tc, tile, width), lambda r, t: (t, r, 0), memory_space=pltpu.VMEM
+    )
+    hs, cs = pl.pallas_call(
+        _tb_fwd_kernel,
+        grid=grid,
+        in_specs=[
+            tblock(four_h),
+            pl.BlockSpec(
+                (hidden, four_h), lambda r, t: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[tblock(hidden), tblock(hidden)],
+        out_shape=[
+            jax.ShapeDtypeStruct((t_pad, b_pad, hidden), x_proj.dtype),
+        ] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((tile, hidden), jnp.float32),
+            pltpu.VMEM((tile, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x_padded, w_hh_t)
+    res = (x_padded, hs, cs, w_hh_t, n_t, b, tile, tc)
+    return hs[:n_t, :b], res
+
+
+def _tb_bwd_kernel(
+    dh_ref, x_ref, hb_ref, cb_ref, h_ref, c_ref, w_ref,
+    dx_out, dw_out, dh_scr, dc_scr, dw_scr,
+):
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dc_scr[:] = jnp.zeros_like(dc_scr)
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    w = w_ref[:].astype(jnp.float32)
+    tc = dh_ref.shape[0]
+
+    def body(kk, _):
+        k = tc - 1 - kk
+        k_prev = jnp.maximum(k - 1, 0)
+        # Step k's h/c predecessors live in this chunk for k>0; the chunk's
+        # first step reads the (1, tile, H) boundary block — h/c at the
+        # END of the previous chunk (zeros for the global first chunk).
+        first = (k == 0)
+        h_prev = jnp.where(
+            first, hb_ref[0].astype(jnp.float32),
+            h_ref[k_prev].astype(jnp.float32),
+        )
+        c_prev = jnp.where(
+            first, cb_ref[0].astype(jnp.float32),
+            c_ref[k_prev].astype(jnp.float32),
+        )
+        gates = x_ref[k].astype(jnp.float32) + lax.dot_general(
+            h_prev, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        gi, gf, gg, go = _gate_math(gates)
+        tanh_c = jnp.tanh(c_ref[k].astype(jnp.float32))
+        dh = dh_ref[k].astype(jnp.float32) + dh_scr[:]
+        do = dh * tanh_c
+        dc = dh * go * (1.0 - tanh_c * tanh_c) + dc_scr[:]
+        di = dc * gg
+        dg = dc * gi
+        df = dc * c_prev
+        dc_scr[:] = dc * gf
+        d_pre = jnp.concatenate(
+            [
+                di * gi * (1.0 - gi),
+                df * gf * (1.0 - gf),
+                dg * (1.0 - gg * gg),
+                do * go * (1.0 - go),
+            ],
+            axis=-1,
+        )
+        dx_out[k] = d_pre.astype(dx_out.dtype)
+        dh_scr[:] = lax.dot_general(
+            d_pre, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dw_scr[:] += lax.dot_general(
+            h_prev, d_pre, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return 0
+
+    lax.fori_loop(0, tc, body, 0)
+
+    @pl.when(pl.program_id(1) == pl.num_programs(1) - 1)
+    def _emit():
+        dw_out[0] = dw_scr[:].astype(dw_out.dtype)
+
+
+def _tb_bwd_pallas(interpret, res, dhs):
+    x_padded, hs, cs, w_hh_t, n_t, b, tile, tc = res
+    t_pad, b_pad, four_h = x_padded.shape
+    hidden = four_h // 4
+    dhs = jnp.pad(
+        _pad_rows(dhs, b_pad), ((0, t_pad - n_t), (0, 0), (0, 0))
+    )
+    grid = (b_pad // tile, t_pad // tc)
+    n_tb = grid[1]
+    # Chunk-boundary stashes: h/c at each chunk's LAST step, shifted one
+    # chunk (zeros for the global first) — a (n_tb, B, H) sliver instead of
+    # full shifted copies of the stash planes.
+    boundary = lambda a: jnp.concatenate(  # noqa: E731
+        [jnp.zeros_like(a[:1]), a[tc - 1 :: tc][:-1]], axis=0
+    )
+
+    rev = lambda width: pl.BlockSpec(  # noqa: E731
+        (tc, tile, width), lambda r, t: (n_tb - 1 - t, r, 0),
+        memory_space=pltpu.VMEM,
+    )
+    rev1 = pl.BlockSpec(
+        (1, tile, hidden), lambda r, t: (n_tb - 1 - t, r, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dx, dw_partial = pl.pallas_call(
+        _tb_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            rev(hidden),    # dh
+            rev(four_h),    # x
+            rev1,           # h boundary (prev chunk's last step)
+            rev1,           # c boundary
+            rev(hidden),    # h stash
+            rev(hidden),    # c stash
+            pl.BlockSpec(
+                (hidden, four_h), lambda r, t: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            rev(four_h),
+            pl.BlockSpec(
+                (1, hidden, four_h), lambda r, t: (r, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t_pad, b_pad, four_h), x_padded.dtype),
+            jax.ShapeDtypeStruct((grid[0], hidden, four_h), w_hh_t.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile, hidden), jnp.float32),
+            pltpu.VMEM((tile, hidden), jnp.float32),
+            pltpu.VMEM((hidden, four_h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dhs, x_padded, boundary(hs), boundary(cs), hs, cs, w_hh_t)
+    return dx[:n_t, :b], jnp.sum(dw_partial, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _lstm_recurrence_tblocked(x_proj, w_hh_t, interpret=False):
+    hs, _ = _tb_fwd_pallas(x_proj, w_hh_t, interpret=interpret)
+    return hs
+
+
+def _tb_vjp_fwd(x_proj, w_hh_t, interpret):
+    return _tb_fwd_pallas(x_proj, w_hh_t, interpret=interpret)
+
+
+_lstm_recurrence_tblocked.defvjp(_tb_vjp_fwd, _tb_bwd_pallas)
 
 
 # ----------------------------------------------- fused layer-pair kernels
@@ -1380,18 +1647,26 @@ def lstm_recurrence(
         )
     if impl in ("pallas", "interpret"):
         interpret = impl == "interpret"
-        b = x_proj.shape[1]
+        n_t, b = x_proj.shape[0], x_proj.shape[1]
+        hidden = w_hh_t.shape[0]
+        itemsize = jnp.dtype(x_proj.dtype).itemsize
         if (
             -(-b // 8) * 8 > SINGLE_TILE_MAX_ROWS
             and window_schedulable(b, window_rows)
             and -(-window_rows // 8) * 8 <= SINGLE_TILE_MAX_ROWS
+            and single_layer_fits(n_t, window_rows, hidden, itemsize)
         ):
             return _map_row_chunks(
                 lambda xs: _lstm_recurrence_pallas(xs[0], w_hh_t, interpret),
                 b // window_rows,
                 x_proj,
             )
-        return _lstm_recurrence_pallas(x_proj, w_hh_t, interpret)
+        if single_layer_fits(n_t, b, hidden, itemsize):
+            return _lstm_recurrence_pallas(x_proj, w_hh_t, interpret)
+        # Long-lookback: full-T VMEM planes don't fit at any row tile —
+        # run the time-blocked kernel (h/c carried across sequential grid
+        # steps; VMEM holds one T-chunk at a time).
+        return _lstm_recurrence_tblocked(x_proj, w_hh_t, interpret)
     if impl == "xla":
         return lstm_recurrence_xla(x_proj, w_hh_t)
     raise ValueError(f"unknown lstm impl: {impl!r}")

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Attribute the canonical train step's wall time on real TPU.
+
+Two instruments (VERDICT r3 #7):
+
+1. A ``jax.profiler`` trace of one canonical epoch (written under
+   logs/.../profile — the raw artifact for trace viewers).
+2. A micro-timing attribution by differences at the canonical shape
+   (100 rows x T=60 x H=64, model=small, fused pair): recurrence forward
+   alone, recurrence forward+backward, whole fused train step (adds input
+   projections, loss, optimizer, metric sums). Differences bound where the
+   0.22 ms/step goes without trace-file parsing.
+
+Run under the grid runner's PAUSE protocol. Prints one JSON line last.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=200):
+    fn(*args)  # compile
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3  # ms
+
+
+def main() -> None:
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.ops.lstm_kernel import lstm_pair_recurrence
+    from masters_thesis_tpu.train import Trainer
+
+    print(f"backend: {jax.default_backend()}", flush=True)
+    smoke = "--smoke" in sys.argv  # CPU plumbing check: tiny shapes
+    n_t, b, hidden = (8, 12, 8) if smoke else (60, 100, 64)
+    rng = np.random.default_rng(0)
+    x1 = jnp.asarray(rng.normal(size=(n_t, b, 4 * hidden)), jnp.float32)
+    w1, wi2, w2 = (
+        jnp.asarray(rng.normal(size=(hidden, 4 * hidden)) * 0.2, jnp.float32)
+        for _ in range(3)
+    )
+    b2 = jnp.asarray(rng.normal(size=(4 * hidden,)) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.normal(size=(n_t, b, hidden)), jnp.float32)
+
+    reps = 5 if smoke else 200
+    fwd = jax.jit(
+        lambda *a: lstm_pair_recurrence(*a, impl="auto")
+    )
+    fwd_ms = timeit(fwd, x1, w1, wi2, b2, w2, None, reps=reps)
+
+    def loss(x1, w1, wi2, b2, w2):
+        return jnp.sum(
+            lstm_pair_recurrence(x1, w1, wi2, b2, w2, None, impl="auto")
+            * w_out
+        )
+
+    fwdbwd = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2, 3, 4)))
+    fwdbwd_ms = timeit(fwdbwd, x1, w1, wi2, b2, w2, reps=reps)
+
+    # Whole-step cost from a short canonical fit (per-step wall incl.
+    # projections, loss math, optimizer, on-device shuffle, metric sums).
+    data_dir = REPO / "data" / "bench_synthetic"
+    n_stocks, n_samples = (6, 20_000) if smoke else (100, 100_000)
+    if smoke:
+        data_dir = REPO / "data" / "smoke_profile"
+    bootstrap_synthetic(data_dir, n_stocks=n_stocks, n_samples=n_samples, seed=0)
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=60, target_window=30, stride=90,
+        batch_size=1,
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    trainer = Trainer(
+        max_epochs=2 if smoke else 5, gradient_clip_val=5.0,
+        check_val_every_n_epoch=10_000,
+        enable_progress_bar=False, enable_model_summary=False, seed=0,
+    )
+    result = trainer.fit(ModelSpec(objective="mse"), dm)
+    step_ms = 1e3 / result.steps_per_sec
+
+    # Profiler trace artifact of one canonical epoch.
+    trace_dir = REPO / "logs" / "profile_r4"
+    trainer2 = Trainer(
+        max_epochs=2 if smoke else 3, gradient_clip_val=5.0,
+        check_val_every_n_epoch=10_000, profile=True,
+        enable_progress_bar=False, enable_model_summary=False, seed=0,
+    )
+    from masters_thesis_tpu.train.logging import TensorBoardLogger
+
+    logger = TensorBoardLogger(str(trace_dir.parent), "profile_r4", "trace")
+    trainer2.logger = logger
+    trainer2.fit(ModelSpec(objective="mse"), dm)
+    trace_glob = list(
+        (logger.log_dir / "profile").rglob("*.xplane.pb")
+    )
+
+    print(json.dumps({
+        "recurrence_fwd_ms": round(fwd_ms, 4),
+        "recurrence_fwd_bwd_ms": round(fwdbwd_ms, 4),
+        "recurrence_bwd_ms": round(fwdbwd_ms - fwd_ms, 4),
+        "full_step_ms": round(step_ms, 4),
+        "non_recurrence_ms": round(step_ms - fwdbwd_ms, 4),
+        "steps_per_sec": round(result.steps_per_sec, 1),
+        "trace_files": [str(p) for p in trace_glob[:3]],
+    }))
+
+
+if __name__ == "__main__":
+    main()

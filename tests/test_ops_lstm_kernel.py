@@ -111,6 +111,70 @@ def test_row_tile_env_override_parity(rng, monkeypatch):
         lstm_recurrence(x_proj, w_hh_t, impl="interpret").block_until_ready()
 
 
+def test_single_layer_fits_long_lookback_guard():
+    """Long lookbacks scale the resident kernel's VMEM planes past budget
+    at ANY row tile — the byte guard must reject them (the dispatcher then
+    takes the time-blocked path instead of a Mosaic compile error)."""
+    from masters_thesis_tpu.ops.lstm_kernel import single_layer_fits
+
+    assert single_layer_fits(60, 100, 64, 4)     # canonical: resident
+    assert not single_layer_fits(600, 100, 64, 4)  # 10x lookback: over
+    assert not single_layer_fits(600, 32, 64, 4)   # smaller tile: still over
+    assert single_layer_fits(600, 100, 8, 4)     # tiny hidden: fits
+
+
+@pytest.mark.parametrize("n_t,b,hidden", [(9, 4, 8), (11, 40, 16)])
+def test_time_blocked_kernel_parity(rng, monkeypatch, n_t, b, hidden):
+    """Time-blocked kernel (h/c carried across sequential grid steps) must
+    match the scan formulation fwd+bwd — forced to SMALL chunks so several
+    time blocks and the cross-chunk carry are exercised."""
+    import masters_thesis_tpu.ops.lstm_kernel as lk
+
+    monkeypatch.setattr(lk, "_tb_time_chunk", lambda *a: 4)
+    x_proj, w_hh_t = _random_case(rng, n_t, b, hidden)
+    ref = lstm_recurrence_xla(x_proj, w_hh_t)
+    out = lk._lstm_recurrence_tblocked(x_proj, w_hh_t, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    w_out = jnp.asarray(rng.normal(size=(n_t, b, hidden)), jnp.float32)
+
+    def loss(fn):
+        return lambda xp, w: jnp.sum(fn(xp, w) * w_out)
+
+    g_ref = jax.grad(loss(lstm_recurrence_xla), argnums=(0, 1))(
+        x_proj, w_hh_t
+    )
+    g_tb = jax.grad(
+        loss(lambda xp, w: lk._lstm_recurrence_tblocked(xp, w, True)),
+        argnums=(0, 1),
+    )(x_proj, w_hh_t)
+    np.testing.assert_allclose(np.asarray(g_tb[0]), np.asarray(g_ref[0]),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(g_tb[1]), np.asarray(g_ref[1]),
+                               atol=2e-4 * max(1, b // 16))
+
+
+def test_long_lookback_dispatches_to_time_blocked(rng, monkeypatch):
+    """lstm_recurrence must route over-budget lookbacks to the
+    time-blocked kernel and still match the scan formulation."""
+    import masters_thesis_tpu.ops.lstm_kernel as lk
+
+    calls = []
+    real = lk._lstm_recurrence_tblocked
+
+    def spy(xp, w, interpret):
+        calls.append(xp.shape)
+        return real(xp, w, interpret)
+
+    monkeypatch.setattr(lk, "_lstm_recurrence_tblocked", spy)
+    monkeypatch.setattr(lk, "single_layer_fits", lambda *a: False)
+    x_proj, w_hh_t = _random_case(rng, 10, 12, 8)
+    out = lstm_recurrence(x_proj, w_hh_t, impl="interpret")
+    assert calls, "time-blocked path not taken"
+    ref = lstm_recurrence_xla(x_proj, w_hh_t)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def _random_pair_case(rng, n_t, b, hidden, *, dropout=0.0):
     """dropout=None -> maskless variant (mask arg is None)."""
     x1 = jnp.asarray(rng.normal(size=(n_t, b, 4 * hidden)), jnp.float32)
