@@ -298,12 +298,26 @@ def main() -> None:
     batch_sweep = {"1": round(value, 2)}
     scaling = None
     if not degraded:
-        nll_sps = _measure(dm1, "nll", max(2, measure_epochs // 2))
+        # Auxiliary sections individually guarded: a compile failure in one
+        # (e.g. a new kernel path's first real-Mosaic encounter) must cost
+        # that section, never the primary metric's JSON line.
+        try:
+            nll_sps = _measure(dm1, "nll", max(2, measure_epochs // 2))
+        except Exception as exc:
+            print(f"nll section failed: {exc!r}"[:800], file=sys.stderr)
         # Batch sweep: amortizing the per-step dispatch floor. windows/sec
         # = steps/sec * batch_size, comparable across points.
         for bs in (8, 32):
-            sps = _measure(make_dm(bs), "mse", max(2, measure_epochs // 2))
-            batch_sweep[str(bs)] = round(sps * bs, 2)
+            try:
+                sps = _measure(
+                    make_dm(bs), "mse", max(2, measure_epochs // 2)
+                )
+                batch_sweep[str(bs)] = round(sps * bs, 2)
+            except Exception as exc:
+                print(
+                    f"batch sweep bs={bs} failed: {exc!r}"[:800],
+                    file=sys.stderr,
+                )
         scaling = _run_scaling_subprocess()
     wall = time.perf_counter() - t0
 

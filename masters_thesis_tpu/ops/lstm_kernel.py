@@ -306,9 +306,11 @@ def _bwd_pallas(interpret, residuals, dhs):
 # lives in scratch ACROSS sequential grid steps (Pallas TPU grids execute
 # in order, innermost axis fastest), so VMEM holds one time chunk at a
 # time while the recurrence itself never leaves the chip. The backward
-# sweep runs the time-chunk axis REVERSED via the index maps, consumes
-# pre-shifted h/c stashes (so no cross-chunk reads), accumulates dw in
-# scratch, and aliases dx over the x chunks like the resident kernel.
+# sweep runs the time-chunk axis REVERSED via the index maps, reads its
+# cross-chunk h/c predecessors from per-chunk boundary slivers (no
+# cross-chunk block reads), accumulates dw in scratch, and keeps x and dx
+# as SEPARATE planes — multi-program grids don't get the resident
+# kernel's dx alias, and the chunk-size model budgets both.
 
 
 def _tb_time_chunk(tile: int, hidden: int, itemsize: int) -> int:

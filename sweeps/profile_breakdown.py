@@ -24,6 +24,27 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+
+def _probe_backend_or_exit(timeout_s: float = 90.0) -> None:
+    """A wedged relay blocks jax backend init forever (bench.py's known
+    failure mode) — probe in a subprocess first and exit loudly instead of
+    silently burning the PAUSE-protocol slot."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True, capture_output=True,
+        )
+    except Exception as exc:
+        sys.exit(
+            f"backend probe failed ({type(exc).__name__}): relay wedged or "
+            "backend broken; not starting the profile run"
+        )
+
+
+_probe_backend_or_exit()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
