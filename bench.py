@@ -65,39 +65,18 @@ MEASURE_EPOCHS = 8
 
 def _ensure_responsive_backend() -> tuple[bool, int]:
     """Probe TPU init with retries; returns (degraded_to_cpu, attempts)."""
-    deadline = time.monotonic() + PROBE_BUDGET_S
-    attempts = 0
-    while True:
-        attempts += 1
-        remaining = deadline - time.monotonic()
-        try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=max(10.0, min(PROBE_TIMEOUT_S, remaining)),
-                check=True,
-                capture_output=True,
-            )
-            return False, attempts
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
-            print(
-                f"device probe attempt {attempts} failed "
-                f"({type(exc).__name__}); {max(0.0, remaining):.0f}s budget left",
-                file=sys.stderr,
-            )
-            if isinstance(exc, subprocess.CalledProcessError):
-                # An instant non-zero exit is a deterministic init crash
-                # (broken libtpu, bad platform pin), not a wedged lease —
-                # retrying for 10 minutes would reproduce the same crash;
-                # degrade now. Only timeouts are worth waiting out.
-                stderr = (exc.stderr or b"").decode(errors="replace")
-                print(stderr[-500:], file=sys.stderr)
-                break
-            if time.monotonic() + PROBE_BACKOFF_S >= deadline:
-                break
-            time.sleep(PROBE_BACKOFF_S)
+    from masters_thesis_tpu.utils import probe_tpu_backend
+
+    probe = probe_tpu_backend(
+        timeout_s=PROBE_TIMEOUT_S,
+        budget_s=PROBE_BUDGET_S,
+        backoff_s=PROBE_BACKOFF_S,
+    )
+    if probe.ok:
+        return False, probe.attempts
     print(
-        f"device probe failed {attempts}x over {PROBE_BUDGET_S:.0f}s; "
-        "falling back to CPU backend",
+        f"device probe failed {probe.attempts}x over {PROBE_BUDGET_S:.0f}s "
+        f"({probe.detail}); falling back to CPU backend",
         file=sys.stderr,
     )
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -107,7 +86,7 @@ def _ensure_responsive_backend() -> tuple[bool, int]:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    return True, attempts
+    return True, probe.attempts
 
 
 def _make_trainer(

@@ -1,5 +1,9 @@
 """Shared host-side utilities."""
 
+from masters_thesis_tpu.utils.backend_probe import (
+    ProbeResult,
+    probe_tpu_backend,
+)
 from masters_thesis_tpu.utils.compilation_cache import (
     enable_persistent_compilation_cache,
 )
@@ -10,8 +14,10 @@ from masters_thesis_tpu.utils.io import (
 )
 
 __all__ = [
+    "ProbeResult",
     "atomic_publish",
     "atomic_write_text",
     "enable_persistent_compilation_cache",
+    "probe_tpu_backend",
     "wait_until",
 ]

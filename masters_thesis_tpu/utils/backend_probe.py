@@ -1,0 +1,71 @@
+"""Subprocess probe for TPU backend liveness.
+
+The axon relay lease can wedge so that ``jax.devices()`` blocks forever
+with no client-side timeout (observed multi-hour outages; RESULTS.md).
+Every script that intends to touch the TPU must therefore probe backend
+init in a SHORT-LIVED subprocess first — this module is the one shared
+implementation of that pattern (bench.py, sweeps/profile_breakdown.py;
+the shell-side grid runner re-implements the same probe in bash).
+
+Policy knobs:
+
+- ``timeout_s``: per-attempt subprocess timeout. A wedged lease hangs the
+  child; the timeout converts that into a retriable failure.
+- ``budget_s``: total retry budget. Wedges often clear within minutes, so
+  callers that can afford to wait should; one-shot callers pass
+  ``budget_s=0``.
+- A CalledProcessError (instant non-zero exit) is a deterministic init
+  crash — broken libtpu, bad platform pin — and is NOT retried: the same
+  crash would reproduce for the whole budget. Its stderr tail is returned
+  so the failure is diagnosable.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+DEFAULT_TIMEOUT_S = 120.0
+DEFAULT_BACKOFF_S = 15.0
+
+
+@dataclass
+class ProbeResult:
+    ok: bool
+    attempts: int
+    detail: str  # "" when ok; reason + child stderr tail otherwise
+
+
+def probe_tpu_backend(
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+    budget_s: float = 0.0,
+    backoff_s: float = DEFAULT_BACKOFF_S,
+) -> ProbeResult:
+    """Probe ``jax.devices()`` in a subprocess; retry timeouts for budget_s."""
+    deadline = time.monotonic() + budget_s
+    attempts = 0
+    detail = ""
+    while True:
+        attempts += 1
+        remaining = deadline - time.monotonic()
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=max(10.0, min(timeout_s, remaining))
+                if budget_s else timeout_s,
+                check=True,
+                capture_output=True,
+            )
+            return ProbeResult(True, attempts, "")
+        except subprocess.CalledProcessError as exc:
+            stderr = (exc.stderr or b"").decode(errors="replace")
+            detail = f"init crashed (rc={exc.returncode}): {stderr[-500:]}"
+            break  # deterministic crash: retrying reproduces it
+        except subprocess.TimeoutExpired:
+            detail = f"probe timed out after attempt {attempts} (wedged lease)"
+            if time.monotonic() + backoff_s >= deadline:
+                break
+            time.sleep(backoff_s)
+    return ProbeResult(False, attempts, detail)

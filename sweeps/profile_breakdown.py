@@ -25,21 +25,18 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
-def _probe_backend_or_exit(timeout_s: float = 90.0) -> None:
+def _probe_backend_or_exit() -> None:
     """A wedged relay blocks jax backend init forever (bench.py's known
-    failure mode) — probe in a subprocess first and exit loudly instead of
-    silently burning the PAUSE-protocol slot."""
-    import subprocess
+    failure mode) — probe in a subprocess first and exit loudly (with the
+    child's stderr for crash diagnosis) instead of silently burning the
+    PAUSE-protocol slot."""
+    from masters_thesis_tpu.utils import probe_tpu_backend
 
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, check=True, capture_output=True,
-        )
-    except Exception as exc:
+    probe = probe_tpu_backend(timeout_s=90.0)
+    if not probe.ok:
         sys.exit(
-            f"backend probe failed ({type(exc).__name__}): relay wedged or "
-            "backend broken; not starting the profile run"
+            f"backend probe failed: {probe.detail}; not starting the "
+            "profile run"
         )
 
 

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-4 TPU work queue: wait for relay health, run the interactive
+# measurement stack while the grid runner is PAUSEd (results/PAUSE), then
+# hand the chip to the grid (rm PAUSE). Timeouts are generous backstops —
+# killing TPU-attached processes can wedge the relay, so they should never
+# fire in a healthy run.
+cd /root/repo || exit 1
+
+# Never leave the grid runner paused if this script dies mid-queue: the
+# PAUSE marker must not outlive the process that owns it.
+trap 'rm -f results/PAUSE results/BENCH_REQUEST' EXIT
+
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) relay wedged; retry in 240s"
+  sleep 240
+done
+echo "$(date -u +%H:%M:%S) relay healthy; starting TPU queue"
+
+echo "== stack kernel Mosaic check =="
+timeout 900 python sweeps/check_stack_tpu.py 2>&1
+
+echo "== fresh bench capture =="
+timeout 2700 python bench.py > results/bench_r4_tpu.json 2> results/bench_r4_tpu.log
+tail -c 400 results/bench_r4_tpu.json
+
+echo "== wavefront A/B sweep =="
+timeout 4500 python sweeps/bench_fused_pair.py 2>&1 | tee results/bench_fused_r4.log
+
+echo "== profile breakdown =="
+timeout 1800 python sweeps/profile_breakdown.py 2>&1 | tee results/profile_r4.log
+
+rm -f results/PAUSE results/BENCH_REQUEST
+echo "$(date -u +%H:%M:%S) TPU queue done; grid unpaused"
