@@ -65,6 +65,16 @@ def probe_tpu_backend(
             break  # deterministic crash: retrying reproduces it
         except subprocess.TimeoutExpired:
             detail = f"probe timed out after attempt {attempts} (wedged lease)"
+            # Per-attempt progress to stderr: an operator tailing the log
+            # must be able to tell "probe retrying through a wedge" from
+            # "caller hung" (the no-kill rule makes that distinction
+            # consequential).
+            print(
+                f"device probe attempt {attempts} timed out; "
+                f"{max(0.0, remaining):.0f}s budget left",
+                file=sys.stderr,
+                flush=True,
+            )
             if time.monotonic() + backoff_s >= deadline:
                 break
             time.sleep(backoff_s)

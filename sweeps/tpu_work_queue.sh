@@ -1,14 +1,21 @@
 #!/bin/bash
-# Round-4 TPU work queue: wait for relay health, run the interactive
-# measurement stack while the grid runner is PAUSEd (results/PAUSE), then
-# hand the chip to the grid (rm PAUSE). Timeouts are generous backstops —
-# killing TPU-attached processes can wedge the relay, so they should never
-# fire in a healthy run.
+# Round-4 TPU work queue: pause the grid runner, wait for relay health AND
+# the grid's in-flight cell to finish, run the interactive measurement
+# stack, then hand the chip back (remove our pause). Timeouts are generous
+# backstops — killing TPU-attached processes can wedge the relay, so they
+# should never fire in a healthy run.
 cd /root/repo || exit 1
 
-# Never leave the grid runner paused if this script dies mid-queue: the
-# PAUSE marker must not outlive the process that owns it.
-trap 'rm -f results/PAUSE results/BENCH_REQUEST' EXIT
+# Own the pause: create it if absent, and on ANY exit remove it only if WE
+# created it (an operator's pre-existing PAUSE is theirs to lift). A
+# pending BENCH_REQUEST is left alone on early death — it is only consumed
+# at the end, once this queue has actually captured a bench itself.
+CREATED_PAUSE=0
+if [ ! -f results/PAUSE ]; then
+  touch results/PAUSE
+  CREATED_PAUSE=1
+fi
+trap '[ "$CREATED_PAUSE" = 1 ] && rm -f results/PAUSE' EXIT
 
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
@@ -17,7 +24,16 @@ while true; do
   echo "$(date -u +%H:%M:%S) relay wedged; retry in 240s"
   sleep 240
 done
-echo "$(date -u +%H:%M:%S) relay healthy; starting TPU queue"
+echo "$(date -u +%H:%M:%S) relay healthy"
+
+# PAUSE only stops the runner from LAUNCHING new cells; an in-flight
+# train.py cell owns the chip until it finishes. Concurrent use crashes it
+# (documented failure mode) — wait it out.
+while pgrep -f "python train.py" > /dev/null 2>&1; do
+  echo "$(date -u +%H:%M:%S) grid cell in flight; waiting 120s"
+  sleep 120
+done
+echo "$(date -u +%H:%M:%S) chip free; starting TPU queue"
 
 echo "== stack kernel Mosaic check =="
 timeout 900 python sweeps/check_stack_tpu.py 2>&1
@@ -32,5 +48,8 @@ timeout 4500 python sweeps/bench_fused_pair.py 2>&1 | tee results/bench_fused_r4
 echo "== profile breakdown =="
 timeout 1800 python sweeps/profile_breakdown.py 2>&1 | tee results/profile_r4.log
 
-rm -f results/PAUSE results/BENCH_REQUEST
+# Queue complete: the opportunistic-bench request is satisfied by the
+# capture above, and the chip goes back to the grid.
+rm -f results/BENCH_REQUEST results/PAUSE
+CREATED_PAUSE=0
 echo "$(date -u +%H:%M:%S) TPU queue done; grid unpaused"

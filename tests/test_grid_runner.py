@@ -1,0 +1,103 @@
+"""Unit tests for the canonical-grid runner's operational helpers.
+
+The runner (sweeps/run_grid_canonical.py) is the round's unattended TPU
+driver; its resume bookkeeping and opportunistic-bench logic must behave
+exactly as documented because nobody watches it run (SURVEY.md §5
+failure-detection analog)."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+import time
+import types
+from pathlib import Path
+
+import pytest
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "_grid_runner", _REPO_ROOT / "sweeps" / "run_grid_canonical.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "RESULTS_DIR", tmp_path)
+    monkeypatch.setattr(mod, "OUT", tmp_path / "grid.jsonl")
+    return mod
+
+
+def test_done_cells_skips_truncated_rows(runner):
+    rows = [
+        {"cell": "a_slow", "truncated": False},
+        {"cell": "b_slow", "truncated": True},   # resumed next run
+        {"cell": "c_slow"},                       # legacy row, no flag
+        {"cell": "b_slow", "truncated": False},  # later completion wins
+    ]
+    runner.OUT.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert runner.done_cells() == {"a_slow", "b_slow", "c_slow"}
+
+
+def test_done_cells_empty_without_file(runner):
+    assert runner.done_cells() == set()
+
+
+def _fake_run(returncode=0, stdout="", stderr=""):
+    def run(cmd, **kwargs):
+        return types.SimpleNamespace(
+            returncode=returncode, stdout=stdout, stderr=stderr
+        )
+
+    return run
+
+
+def test_maybe_run_bench_consumes_marker_on_success(runner, monkeypatch):
+    (runner.RESULTS_DIR / "BENCH_REQUEST").touch()
+    monkeypatch.setattr(
+        runner.subprocess, "run",
+        _fake_run(stdout='{"metric": "x", "value": 1}\n'),
+    )
+    runner.maybe_run_bench(deadline=time.time() + 3600)
+    assert not (runner.RESULTS_DIR / "BENCH_REQUEST").exists()
+    out = (runner.RESULTS_DIR / "bench_opportunistic.jsonl").read_text()
+    assert json.loads(out.strip())["value"] == 1
+
+
+def test_maybe_run_bench_consumes_marker_on_failure(runner, monkeypatch):
+    """A failing bench must not be retried forever on the chip's time —
+    the marker is consumed either way (re-touch to request another)."""
+    (runner.RESULTS_DIR / "BENCH_REQUEST").touch()
+    monkeypatch.setattr(
+        runner.subprocess, "run", _fake_run(returncode=1, stderr="boom")
+    )
+    runner.maybe_run_bench(deadline=time.time() + 3600)
+    assert not (runner.RESULTS_DIR / "BENCH_REQUEST").exists()
+    assert not (runner.RESULTS_DIR / "bench_opportunistic.jsonl").exists()
+
+
+def test_maybe_run_bench_respects_deadline(runner, monkeypatch):
+    """Too close to the deadline: no TPU time spent, marker kept for a
+    future run with budget."""
+    (runner.RESULTS_DIR / "BENCH_REQUEST").touch()
+
+    def explode(*a, **k):  # pragma: no cover - must not be called
+        raise AssertionError("bench launched past the deadline")
+
+    monkeypatch.setattr(runner.subprocess, "run", explode)
+    runner.maybe_run_bench(deadline=time.time() + 60)
+    assert (runner.RESULTS_DIR / "BENCH_REQUEST").exists()
+
+
+def test_maybe_run_bench_noop_without_marker(runner, monkeypatch):
+    def explode(*a, **k):  # pragma: no cover
+        raise AssertionError("bench launched without a request")
+
+    monkeypatch.setattr(runner.subprocess, "run", explode)
+    runner.maybe_run_bench(deadline=time.time() + 3600)
+
+
+def test_version_for_matches_log_layout(runner):
+    assert runner.version_for("mse", "small", "slow") == "mse_small_lr0.0001_slow"
