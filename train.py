@@ -109,6 +109,7 @@ def build_spec(cfg: Config):
         learning_rate=cfg.model.learning_rate,
         weight_decay=cfg.model.weight_decay,
         remat=cfg.model.get("remat", False),
+        kernel_impl=cfg.model.get("kernel_impl", "auto"),
     )
     if "mse_weight" in cfg.loss:
         hparams["mse_weight"] = cfg.loss.mse_weight
