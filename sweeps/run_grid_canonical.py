@@ -135,6 +135,99 @@ def version_for(loss: str, model: str, trainer: str) -> str:
     return f"{loss}_{model}_lr0.0001_{trainer}"
 
 
+def train_with_retry(
+    cell: str, train_overrides: list[str], budget: float, deadline: float
+) -> tuple[bool, bool]:
+    """Run train.py (with resume) under a wall budget, retrying once after
+    a transient relay failure. Returns ``(completed, truncated)``:
+    completed means train.py exited 0; truncated means the budget or
+    timeout cut training short (the checkpoint, if any, is partial — a
+    re-run with trainer.resume=true continues it)."""
+    t0 = time.time()
+    attempts = 0
+    while True:
+        attempts += 1
+        remaining = budget - (time.time() - t0)
+        if remaining <= 60:
+            log(f"{cell}: cell budget exhausted before attempt {attempts}")
+            return False, True
+        try:
+            train = subprocess.run(
+                [sys.executable, "train.py", *train_overrides,
+                 "trainer.resume=true", "trainer.enable_model_summary=false"],
+                cwd=REPO,
+                timeout=remaining,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"{cell}: train hit its cap after {remaining:.0f}s "
+                f"(cell budget {budget:.0f}s); resume will continue it on "
+                "a re-run")
+            return False, True
+        if train.returncode == 0:
+            return True, False
+        # A wedged/crashed relay surfaces as UNAVAILABLE backend errors —
+        # transient, not a property of the cell. Re-probe the TPU and give
+        # the cell ONE more attempt (trainer.resume=true makes the retry
+        # continue from the last val-epoch checkpoint, not restart). The
+        # budget re-check at the top of the loop keeps a long wedge inside
+        # wait_for_tpu from granting an attempt past the deadline. Search
+        # the FULL captured output — progress lines after the backend error
+        # can push the marker out of any fixed-size tail.
+        full = train.stdout + train.stderr
+        transient = "UNAVAILABLE" in full or "Unavailable" in full
+        if transient and attempts == 1 and wait_for_tpu(deadline):
+            log(f"{cell}: transient backend failure; retrying once")
+            continue
+        log(f"{cell}: train FAILED rc={train.returncode}\n"
+            f"{train.stdout[-1500:]}\n{train.stderr[-1500:]}")
+        return False, False
+
+
+def ensure_checkpoint(
+    cell: str, train_overrides: list[str], ckpt: Path, deadline: float
+) -> bool:
+    """Regenerate a checkpoint whose CELL is already recorded but whose
+    files are gone (checkpoints don't survive an environment reset; only
+    the results JSONL does). Trains without recording a new row — the
+    recorded metrics stand; this only restores the weights that downstream
+    cells (the warmup block's pretrain) need to warm-start from.
+
+    A checkpoint counts as restored only once train.py has COMPLETED
+    (exit 0): a budget-truncated retrain leaves a partial val-epoch
+    checkpoint at the same path, and warm-starting the scratch-vs-warmup
+    comparison from under-trained pretrain weights would silently
+    invalidate it. Completion is recorded in a marker file next to the
+    checkpoint (same lifetime: both live in logs/, both die in a reset);
+    the checkpoint protocol never touches foreign files in its dir."""
+    marker = ckpt.parent / f"{ckpt.name}.ENSURED"
+    if ckpt.exists() and marker.exists():
+        return True
+    if not wait_for_tpu(deadline):
+        log(f"ensure {cell}: TPU never became ready before deadline")
+        return False
+    budget = min(PER_CELL_CAP_S, deadline - time.time())
+    if budget < 300:
+        log(f"ensure {cell}: deadline reached")
+        return False
+    log(f"ensure {cell}: checkpoint missing or unconfirmed; training to "
+        "completion (not re-recorded)")
+    completed, truncated = train_with_retry(
+        cell, train_overrides, budget, deadline
+    )
+    if not completed:
+        if truncated and ckpt.exists():
+            log(f"ensure {cell}: retrain truncated; partial checkpoint NOT "
+                "used (re-run resumes it)")
+        return False
+    if not ckpt.exists():
+        log(f"ensure {cell}: train completed but no checkpoint at {ckpt}")
+        return False
+    marker.touch()
+    return True
+
+
 def run_cell(
     cell: str,
     train_overrides: list[str],
@@ -158,49 +251,18 @@ def run_cell(
 
     log(f"train {cell}")
     t0 = time.time()
-    truncated = False
-    attempts = 0
-    while True:
-        attempts += 1
-        remaining = budget - (time.time() - t0)
-        if remaining <= 60:
-            truncated = True
-            log(f"{cell}: cell budget exhausted before attempt {attempts}; "
-                "evaluating the last checkpoint")
-            break
-        try:
-            train = subprocess.run(
-                [sys.executable, "train.py", *train_overrides,
-                 "trainer.resume=true", "trainer.enable_model_summary=false"],
-                cwd=REPO,
-                timeout=remaining,
-                capture_output=True,
-                text=True,
-            )
-        except subprocess.TimeoutExpired:
-            truncated = True
-            log(f"{cell}: train hit its cap after {remaining:.0f}s "
-                f"(cell budget {budget:.0f}s); evaluating the last "
-                "checkpoint (resume will continue it on a re-run)")
-            break
-        if train.returncode == 0:
-            break
-        # A wedged/crashed relay surfaces as UNAVAILABLE backend errors —
-        # transient, not a property of the cell. Re-probe the TPU and give
-        # the cell ONE more attempt (trainer.resume=true makes the retry
-        # continue from the last val-epoch checkpoint, not restart). The
-        # budget re-check at the top of the loop keeps a long wedge inside
-        # wait_for_tpu from granting an attempt past the deadline. Search
-        # the FULL captured output — progress lines after the backend error
-        # can push the marker out of any fixed-size tail.
-        full = train.stdout + train.stderr
-        transient = "UNAVAILABLE" in full or "Unavailable" in full
-        if transient and attempts == 1 and wait_for_tpu(deadline):
-            log(f"{cell}: transient backend failure; retrying once")
-            continue
-        log(f"{cell}: train FAILED rc={train.returncode}\n"
-            f"{train.stdout[-1500:]}\n{train.stderr[-1500:]}")
-        return
+    completed, truncated = train_with_retry(
+        cell, train_overrides, budget, deadline
+    )
+    if not completed and not truncated:
+        return  # hard failure, already logged
+    if truncated:
+        log(f"{cell}: evaluating the last checkpoint")
+    if completed and ckpt.exists():
+        # Record completion for ensure_checkpoint: a cell run_cell finished
+        # is exactly as confirmed as one ensure_checkpoint finished, and
+        # without the marker a later ensure would re-launch train.py.
+        (ckpt.parent / f"{ckpt.name}.ENSURED").touch()
     wall = time.time() - t0
 
     if not ckpt.exists():
@@ -261,19 +323,35 @@ def main() -> None:
         "datamodule.dgp_variant=outliers",
         "datamodule.data_dir=data/synthetic_outliers",
     ]
-    if pre.exists():
-        for loss in LOSSES:
-            # From-scratch baseline on the fine-tune dataset...
-            run_cell(
-                f"outliers_{loss}_large_scratch",
-                ["model=large", f"loss={loss}", "trainer=slow", *outlier_ov,
-                 "logger.name=FinancialLstm/outliers"],
-                REPO / "logs/FinancialLstm/outliers"
-                / version_for(loss, "large", "slow") / "checkpoints/best",
-                outlier_ov,
-                deadline,
-            )
-            # ...vs warm-started from the synthetic-pretrained weights
+    # From-scratch baselines on the fine-tune dataset: independent of the
+    # pretrain checkpoint, so they run regardless of the ensure below.
+    for loss in LOSSES:
+        run_cell(
+            f"outliers_{loss}_large_scratch",
+            ["model=large", f"loss={loss}", "trainer=slow", *outlier_ov,
+             "logger.name=FinancialLstm/outliers"],
+            REPO / "logs/FinancialLstm/outliers"
+            / version_for(loss, "large", "slow") / "checkpoints/best",
+            outlier_ov,
+            deadline,
+        )
+    # Warm-started cells need the pretrain weights; only spend TPU time
+    # restoring those (ensure_checkpoint may retrain for hours) if at
+    # least one warmup cell is still unrecorded.
+    pending_warmup = [
+        loss for loss in LOSSES
+        if f"outliers_{loss}_large_warmup" not in done_cells()
+    ]
+    if not pending_warmup:
+        log("warmup cells all recorded; pretrain ensure skipped")
+    elif ensure_checkpoint(
+        "combined_large_slow",
+        ["model=large", "loss=combined", "trainer=slow"],
+        pre,
+        deadline,
+    ):
+        for loss in pending_warmup:
+            # Warm-started from the synthetic-pretrained weights
             # (fresh optimizer: checkpoint_mode=params).
             run_cell(
                 f"outliers_{loss}_large_warmup",
@@ -286,7 +364,9 @@ def main() -> None:
                 deadline,
             )
     else:
-        log("warmup block skipped: pretrain checkpoint missing")
+        log("warmup cells skipped: pretrain checkpoint unavailable "
+            "(missing, unconfirmed, or its retrain did not finish — see "
+            "ensure log above)")
 
     # ---- 3. slowest column, cheapest models first -----------------------
     for model in MODELS:

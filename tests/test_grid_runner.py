@@ -101,3 +101,104 @@ def test_maybe_run_bench_noop_without_marker(runner, monkeypatch):
 
 def test_version_for_matches_log_layout(runner):
     assert runner.version_for("mse", "small", "slow") == "mse_small_lr0.0001_slow"
+
+
+def test_ensure_checkpoint_noop_when_confirmed(runner, monkeypatch, tmp_path):
+    ckpt = tmp_path / "best"
+    ckpt.mkdir()
+    (tmp_path / "best.ENSURED").touch()
+
+    def explode(*a, **k):  # pragma: no cover - must not be called
+        raise AssertionError("retrained despite a confirmed checkpoint")
+
+    monkeypatch.setattr(runner.subprocess, "run", explode)
+    assert runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
+
+
+def test_ensure_checkpoint_retrains_missing(runner, monkeypatch, tmp_path):
+    """An environment reset wipes logs/ but not the results JSONL: the
+    recorded pretrain cell must be retrained (not skipped) so the warmup
+    block can warm-start from it. Completion writes the marker, so a second
+    call is a no-op."""
+    ckpt = tmp_path / "best"
+    calls = []
+
+    def fake_train(cmd, **kwargs):
+        calls.append(cmd)
+        assert "train.py" in cmd[1]
+        ckpt.mkdir()
+        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+
+    monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
+    monkeypatch.setattr(runner.subprocess, "run", fake_train)
+    assert runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
+    assert (tmp_path / "best.ENSURED").exists()
+    assert runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
+    assert len(calls) == 1
+
+
+def test_ensure_checkpoint_reports_failure(runner, monkeypatch, tmp_path):
+    ckpt = tmp_path / "best"
+    monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
+    monkeypatch.setattr(
+        runner.subprocess, "run", _fake_run(returncode=1, stderr="boom")
+    )
+    assert not runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
+
+
+def test_ensure_checkpoint_rejects_partial_on_timeout(
+    runner, monkeypatch, tmp_path
+):
+    """A budget-truncated retrain leaves a PARTIAL checkpoint at the
+    target path; ensure_checkpoint must not bless it (the warmup
+    comparison would warm-start from under-trained weights), and a later
+    call must resume training rather than fast-path on existence."""
+    ckpt = tmp_path / "best"
+    calls = []
+
+    def timeout_train(cmd, **kwargs):
+        calls.append(cmd)
+        ckpt.mkdir(exist_ok=True)  # val-epoch checkpoint landed mid-train
+        raise subprocess.TimeoutExpired(cmd, 1)
+
+    monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
+    monkeypatch.setattr(runner.subprocess, "run", timeout_train)
+    assert not runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
+    assert not (tmp_path / "best.ENSURED").exists()
+    # Second call: checkpoint exists but is unconfirmed -> trains again.
+    assert not runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
+    assert len(calls) == 2
+
+
+def test_train_with_retry_retries_transient_backend_failure(
+    runner, monkeypatch
+):
+    attempts = []
+
+    def flaky(cmd, **kwargs):
+        attempts.append(cmd)
+        if len(attempts) == 1:
+            return types.SimpleNamespace(
+                returncode=1, stdout="x" * 5000 + "UNAVAILABLE: relay",
+                stderr="",
+            )
+        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+
+    monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
+    monkeypatch.setattr(runner.subprocess, "run", flaky)
+    completed, truncated = runner.train_with_retry(
+        "c", [], budget=3600, deadline=time.time() + 3600
+    )
+    assert completed and not truncated
+    assert len(attempts) == 2
+
+
+def test_train_with_retry_truncates_on_timeout(runner, monkeypatch):
+    def timeout_train(cmd, **kwargs):
+        raise subprocess.TimeoutExpired(cmd, 1)
+
+    monkeypatch.setattr(runner.subprocess, "run", timeout_train)
+    completed, truncated = runner.train_with_retry(
+        "c", [], budget=3600, deadline=time.time() + 3600
+    )
+    assert not completed and truncated
