@@ -70,6 +70,7 @@ def test_train_cli_end_to_end(cli_run):
     assert list(version_dir.glob("events.out.tfevents.*"))
 
 
+@pytest.mark.slow
 def test_eval_cli_renders_figures_and_deltas(cli_run, capsys):
     root, overrides = cli_run
     ckpt = root / "logs" / "FinancialLstm" / "synthetic" / "cli_test"
@@ -386,6 +387,7 @@ def test_multirun_parallel_launcher_numbered_dirs(tmp_path, capsys, monkeypatch)
         assert (versions[0] / "checkpoints" / "best").exists()
 
 
+@pytest.mark.slow
 def test_multirun_parallel_launcher(tmp_path, capsys, monkeypatch):
     """`-m` with launcher.n_jobs=2 runs each sweep point in its own worker
     process (the reference's joblib launcher semantics,

@@ -467,6 +467,7 @@ def test_stack_window_scheduled_parity(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_encoder_deep_wavefront_matches_per_layer(rng, monkeypatch):
     """Full encoder, deterministic mode: the deep-wavefront grouping must
     agree with both the per-layer path and the pair grouping for depths

@@ -180,6 +180,7 @@ class TestCheckpoint:
             pre.history[-1]["loss/total/train"], rel=0.5
         )
 
+    @pytest.mark.slow
     def test_warmup_transfers_across_dgp_variants(self, tiny_dm, tmp_path):
         """The thesis' warmup premise, cross-dataset: pretraining on one
         distribution (no_outliers DGP) then fine-tuning briefly on another
@@ -519,6 +520,7 @@ class TestPlateauScheduler:
 
 
 class TestReproducibility:
+    @pytest.mark.slow
     def test_same_seed_same_history(self, tiny_dm):
         """Identical seeds must reproduce the loss history bit-for-bit —
         every RNG consumer (init, shuffle, dropout) is explicitly keyed."""
