@@ -193,6 +193,40 @@ def test_train_with_retry_retries_transient_backend_failure(
     assert len(attempts) == 2
 
 
+def test_ab_sweep_survives_child_timeout(monkeypatch, capsys):
+    """One starved/wedged child must cost its POINT, not the sweep: the
+    orchestrator skips it and still reports the points that ran
+    (regression: an uncaught TimeoutExpired killed the whole A/B run)."""
+    spec = importlib.util.spec_from_file_location(
+        "_fused_bench", _REPO_ROOT / "sweeps" / "bench_fused_pair.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    calls = []
+
+    def fake_child(cmd, **kwargs):
+        mode = cmd[cmd.index("--child") + 1]
+        calls.append(mode)
+        if mode == "perlayer":
+            raise subprocess.TimeoutExpired(cmd, 900)
+        return types.SimpleNamespace(
+            returncode=0,
+            stdout=json.dumps(
+                {"mode": mode, "model": "small", "steps_per_sec": 100.0}
+            ),
+            stderr="",
+        )
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_child)
+    monkeypatch.setattr(mod.sys, "argv", ["bench_fused_pair.py", "small"])
+    mod.main()
+    out = capsys.readouterr().out
+    assert "TIMEOUT" in out and "skipping" in out
+    assert calls == list(mod.MODES)  # every point attempted
+    assert '"mode": "pair"' in out  # surviving points still reported
+
+
 def test_train_with_retry_truncates_on_timeout(runner, monkeypatch):
     def timeout_train(cmd, **kwargs):
         raise subprocess.TimeoutExpired(cmd, 1)
