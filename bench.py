@@ -243,11 +243,78 @@ def _fused_pair_enabled() -> bool:
     return pair_fusion_enabled()
 
 
+# The relay can also wedge MID-measurement — after a passing probe — which
+# would hang this process inside an epoch dispatch with no JSON line ever
+# printed (the probe only guards backend INIT). Every TPU-touching
+# measurement therefore runs in a watchdog subprocess: a hang costs that
+# SECTION (or degrades the headline to the CPU path), never the one JSON
+# line the driver records. Children share the persistent XLA compile
+# cache, so the extra process startups re-trace but rarely re-compile.
+POINT_TIMEOUT_HEADLINE_S = 1200.0
+POINT_TIMEOUT_AUX_S = 700.0
+
+
+def _point_child(objective: str, batch_size: int, epochs: int) -> None:
+    """Measure one (objective, batch_size) point; prints one JSON line."""
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+
+    data_dir = Path(__file__).resolve().parent / "data" / "bench_synthetic"
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=60, target_window=30, stride=90,
+        batch_size=batch_size,
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    sps = _measure(dm, objective, epochs)
+    import jax
+
+    print(json.dumps({
+        "steps_per_sec": sps,
+        "platform": jax.devices()[0].platform,
+        "windows_per_epoch": len(dm.train_range),
+    }))
+
+
+def _measure_point(
+    objective: str, batch_size: int, epochs: int, timeout_s: float
+) -> dict | None:
+    """Watchdogged measurement; None on hang/crash (logged, never raised)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, __file__, "--point", objective,
+             str(batch_size), str(epochs)],
+            cwd=Path(__file__).resolve().parent,
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"point {objective}/bs={batch_size} hung past {timeout_s:.0f}s "
+            "(mid-measurement relay wedge); skipping the section",
+            file=sys.stderr,
+        )
+        return None
+    if out.returncode != 0:
+        print(
+            f"point {objective}/bs={batch_size} failed rc={out.returncode}: "
+            f"{(out.stderr or '')[-500:]}",
+            file=sys.stderr,
+        )
+        return None
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        print(
+            f"point {objective}/bs={batch_size} printed no JSON: "
+            f"{out.stdout[-300:]}",
+            file=sys.stderr,
+        )
+        return None
+
+
 def main() -> None:
     degraded, probe_attempts = _ensure_responsive_backend()
-    # CPU fallback is ~300x slower per step: trim the measurement window so
-    # the run still finishes inside a driver timeout.
-    measure_epochs = 2 if degraded else MEASURE_EPOCHS
     from masters_thesis_tpu.data.pipeline import (
         FinancialWindowDataModule,
         bootstrap_synthetic,
@@ -256,51 +323,60 @@ def main() -> None:
     data_dir = Path(__file__).resolve().parent / "data" / "bench_synthetic"
     bootstrap_synthetic(data_dir, n_stocks=N_STOCKS, n_samples=N_SAMPLES, seed=0)
 
-    def make_dm(batch_size: int) -> FinancialWindowDataModule:
-        dm = FinancialWindowDataModule(
-            data_dir, lookback_window=60, target_window=30, stride=90,
-            batch_size=batch_size,
-        )
-        dm.prepare_data(verbose=False)
-        dm.setup()
-        return dm
-
     t0 = time.perf_counter()
-    dm1 = make_dm(1)
-    value = _measure(dm1, "mse", measure_epochs)
+    headline = None
+    if not degraded:
+        # Healthy probe: all device-touching measurements run behind
+        # watchdog subprocesses (a mid-measurement wedge must not hang
+        # this process — see the watchdog comment above).
+        headline = _measure_point(
+            "mse", 1, MEASURE_EPOCHS, POINT_TIMEOUT_HEADLINE_S
+        )
+        if headline is None:
+            degraded = True
+            os.environ["JAX_PLATFORMS"] = "cpu"
 
-    # Degraded (wedged relay, CPU fallback): the probe already burned its
-    # 600s budget — measure ONLY the headline point so the one JSON line is
-    # guaranteed to print inside the driver timeout; the auxiliary sections
-    # go null rather than risking no measurement at all.
+    # CPU fallback is ~300x slower per step: trim the measurement window so
+    # the run still finishes inside a driver timeout. Measured in-process —
+    # the CPU backend cannot wedge.
+    measure_epochs = 2 if degraded else MEASURE_EPOCHS
+    if degraded:
+        dm1 = FinancialWindowDataModule(
+            data_dir, lookback_window=60, target_window=30, stride=90,
+            batch_size=1,
+        )
+        dm1.prepare_data(verbose=False)
+        dm1.setup()
+        value = _measure(dm1, "mse", measure_epochs)
+        windows_per_epoch = len(dm1.train_range)
+        import jax
+
+        platform = jax.devices()[0].platform
+    else:
+        value = headline["steps_per_sec"]
+        windows_per_epoch = headline["windows_per_epoch"]
+        platform = headline["platform"]
+
+    # Degraded (wedged relay, CPU fallback): the probe/watchdog already
+    # burned its budget — measure ONLY the headline point so the one JSON
+    # line is guaranteed to print inside the driver timeout; the auxiliary
+    # sections go null rather than risking no measurement at all.
     nll_sps = None
     batch_sweep = {"1": round(value, 2)}
     scaling = None
     if not degraded:
-        # Auxiliary sections individually guarded: a compile failure in one
-        # (e.g. a new kernel path's first real-Mosaic encounter) must cost
-        # that section, never the primary metric's JSON line.
-        try:
-            nll_sps = _measure(dm1, "nll", max(2, measure_epochs // 2))
-        except Exception as exc:
-            print(f"nll section failed: {exc!r}"[:800], file=sys.stderr)
+        aux_epochs = max(2, MEASURE_EPOCHS // 2)
+        point = _measure_point("nll", 1, aux_epochs, POINT_TIMEOUT_AUX_S)
+        if point is not None:
+            nll_sps = point["steps_per_sec"]
         # Batch sweep: amortizing the per-step dispatch floor. windows/sec
         # = steps/sec * batch_size, comparable across points.
         for bs in (8, 32):
-            try:
-                sps = _measure(
-                    make_dm(bs), "mse", max(2, measure_epochs // 2)
-                )
-                batch_sweep[str(bs)] = round(sps * bs, 2)
-            except Exception as exc:
-                print(
-                    f"batch sweep bs={bs} failed: {exc!r}"[:800],
-                    file=sys.stderr,
-                )
+            point = _measure_point("mse", bs, aux_epochs, POINT_TIMEOUT_AUX_S)
+            if point is not None:
+                batch_sweep[str(bs)] = round(point["steps_per_sec"] * bs, 2)
         scaling = _run_scaling_subprocess()
     wall = time.perf_counter() - t0
-
-    import jax
 
     result = {
         "metric": "train_steps_per_sec_per_chip",
@@ -308,11 +384,11 @@ def main() -> None:
         "unit": "steps/s",
         "vs_baseline": round(value / BASELINE_STEPS_PER_SEC, 3),
         "detail": {
-            "windows_per_epoch": len(dm1.train_range),
+            "windows_per_epoch": windows_per_epoch,
             "batch_size": 1,
             "measure_epochs": measure_epochs,
             "wall_s": round(wall, 1),
-            "device": jax.devices()[0].platform,
+            "device": platform,
             "probe_attempts": probe_attempts,
             # Whether pair fusion was ENABLED (env kill-switch); the Pallas
             # pair kernel additionally requires a TPU backend and a shape
@@ -359,5 +435,10 @@ def main() -> None:
 if __name__ == "__main__":
     if "--scaling-child" in sys.argv:
         _scaling_child()
+    elif "--point" in sys.argv:
+        i = sys.argv.index("--point")
+        _point_child(
+            sys.argv[i + 1], int(sys.argv[i + 2]), int(sys.argv[i + 3])
+        )
     else:
         main()

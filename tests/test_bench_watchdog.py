@@ -1,0 +1,72 @@
+"""Unit tests for bench.py's mid-measurement watchdog.
+
+The device probe only guards backend INIT; the relay can also wedge
+mid-measurement and hang the bench forever with no JSON line printed
+(the driver's one recorded artifact). `_measure_point` runs every
+TPU-touching section in a watchdog subprocess so a hang costs that
+section, never the line."""
+
+import importlib.util
+import json
+import subprocess
+import types
+from pathlib import Path
+
+import pytest
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "_bench", _REPO_ROOT / "bench.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_measure_point_returns_payload(bench, monkeypatch):
+    payload = {"steps_per_sec": 123.4, "platform": "tpu",
+               "windows_per_epoch": 777}
+
+    def fake_run(cmd, **kwargs):
+        assert "--point" in cmd
+        return types.SimpleNamespace(
+            returncode=0, stdout=json.dumps(payload) + "\n", stderr=""
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._measure_point("mse", 1, 8, 60.0) == payload
+
+
+def test_measure_point_none_on_hang(bench, monkeypatch, capsys):
+    def hang(cmd, **kwargs):
+        raise subprocess.TimeoutExpired(cmd, kwargs.get("timeout"))
+
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    assert bench._measure_point("mse", 1, 8, 60.0) is None
+    assert "wedge" in capsys.readouterr().err
+
+
+def test_measure_point_none_on_crash(bench, monkeypatch, capsys):
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda cmd, **k: types.SimpleNamespace(
+            returncode=1, stdout="", stderr="boom"
+        ),
+    )
+    assert bench._measure_point("nll", 1, 4, 60.0) is None
+    assert "boom" in capsys.readouterr().err
+
+
+def test_measure_point_none_on_garbage_stdout(bench, monkeypatch, capsys):
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda cmd, **k: types.SimpleNamespace(
+            returncode=0, stdout="not json", stderr=""
+        ),
+    )
+    assert bench._measure_point("mse", 8, 4, 60.0) is None
+    assert "no JSON" in capsys.readouterr().err
