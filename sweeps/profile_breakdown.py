@@ -32,7 +32,11 @@ def _probe_backend_or_exit() -> None:
     PAUSE-protocol slot."""
     from masters_thesis_tpu.utils import probe_tpu_backend
 
-    probe = probe_tpu_backend(timeout_s=90.0)
+    # Retry across a 10-minute budget: this script runs LAST in the TPU
+    # measurement queue, right after long kernel sweeps — the moment a
+    # transient wedge is most likely to be present and also most likely
+    # to clear shortly.
+    probe = probe_tpu_backend(timeout_s=90.0, budget_s=600.0)
     if not probe.ok:
         sys.exit(
             f"backend probe failed: {probe.detail}; not starting the "
