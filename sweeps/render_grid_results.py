@@ -60,6 +60,35 @@ def main() -> None:
           "(all runs incl. resumed); truncated: "
           f"{sum(1 for r in cells.values() if r.get('truncated'))}")
 
+    # Scratch-vs-warmup comparison (the thesis' headline protocol,
+    # tex/diplomski_rad.tex:1134-1147): for each objective on the
+    # fine-tune dataset, from-scratch training vs warm-started from the
+    # synthetic-pretrained weights, plus the OLS baseline on that data.
+    pairs = []
+    for loss in ("mse", "nll", "combined"):
+        scratch = cells.get(f"outliers_{loss}_large_scratch")
+        warm = cells.get(f"outliers_{loss}_large_warmup")
+        if scratch or warm:
+            pairs.append((loss, scratch, warm))
+    if pairs:
+        print("\n### Warmup protocol (fine-tune dataset: outliers DGP)\n")
+        print("| Objective | ΔL_MIX scratch | ΔL_MIX warmup | ΔL_MIX OLS | "
+              "warmup wins? |")
+        print("|---|---|---|---|---|")
+        for loss, scratch, warm in pairs:
+            s = scratch["model"]["delta_mix"] if scratch else None
+            w = warm["model"]["delta_mix"] if warm else None
+            ols = (scratch or warm)["ols"]["delta_mix"]
+            verdict = (
+                "?" if s is None or w is None
+                else ("yes" if w < s else "no")
+            )
+            print(
+                f"| {loss} | {s if s is None else f'{s:.3f}'} | "
+                f"{w if w is None else f'{w:.3f}'} | {ols:.3f} | "
+                f"{verdict} |"
+            )
+
 
 if __name__ == "__main__":
     main()
