@@ -227,6 +227,37 @@ def test_ab_sweep_survives_child_timeout(monkeypatch, capsys):
     assert '"mode": "pair"' in out  # surviving points still reported
 
 
+def test_renderer_warmup_table(monkeypatch, tmp_path, capsys):
+    """The scratch-vs-warmup table must render per-objective verdicts and
+    tolerate half-complete pairs (warmup cell still pending)."""
+    spec = importlib.util.spec_from_file_location(
+        "_renderer", _REPO_ROOT / "sweeps" / "render_grid_results.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def row(cell, mix_model, mix_ols):
+        return {
+            "cell": cell, "epoch": 31, "train_wall_s": 60.0,
+            "model": {"delta_mse": 1e-2, "delta_nll": 1.0,
+                      "delta_mix": mix_model},
+            "ols": {"delta_mse": 2e-2, "delta_nll": 2.0,
+                    "delta_mix": mix_ols},
+        }
+
+    out = tmp_path / "grid.jsonl"
+    out.write_text("".join(json.dumps(r) + "\n" for r in [
+        row("outliers_mse_large_scratch", 2139.0, 2299.0),
+        row("outliers_mse_large_warmup", 2050.0, 2299.0),
+        row("outliers_nll_large_scratch", 1000.0, 1100.0),  # warmup pending
+    ]))
+    monkeypatch.setattr(mod, "OUT", out)
+    mod.main()
+    text = capsys.readouterr().out
+    assert "| mse | 2139.000 | 2050.000 | 2299.000 | yes |" in text
+    assert "| nll | 1000.000 | None | 1100.000 | ? |" in text
+
+
 def test_train_with_retry_truncates_on_timeout(runner, monkeypatch):
     def timeout_train(cmd, **kwargs):
         raise subprocess.TimeoutExpired(cmd, 1)
