@@ -22,8 +22,9 @@ The single JSON line also carries (in "detail"):
   this process) — strong scaling at fixed global batch (the honest
   tiny-batch hard case) plus a same-total-work sharding-overhead ratio
   (the transferable cost of partitioning + psum at the weak-scaling
-  program shape) — the methodology artifact for the 1→8→32-chip north
-  star; on virtual devices it measures program structure, not real ICI.
+  program shape), measured as a median over interleaved replicas with
+  spread — the methodology artifact for the 1→8→32-chip north star; on
+  virtual devices it measures program structure, not real ICI.
 
 vs_baseline: the reference publishes no throughput numbers (SURVEY.md §6).
 The denominator used here is 200 steps/sec/chip — a deliberately generous
@@ -39,6 +40,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -79,14 +81,26 @@ def _ensure_responsive_backend() -> tuple[bool, int]:
         f"({probe.detail}); falling back to CPU backend",
         file=sys.stderr,
     )
+    _pin_cpu_in_process()
+    return True, probe.attempts
+
+
+def _pin_cpu_in_process() -> None:
+    """Force THIS process onto the CPU backend, even after ``import jax``.
+
+    JAX captures ``JAX_PLATFORMS`` at import time, so the env var alone is
+    not enough once anything has imported jax (ADVICE r4); the config update
+    is what actually pins the platform pre-init, and the relay plugin
+    trigger env must go too or it re-selects the TPU plugin regardless.
+    """
     os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    return True, probe.attempts
 
 
 def _make_trainer(
@@ -153,29 +167,26 @@ def _scaling_child() -> None:
     sps_1 = run(1, global_batch)  # 1 device x 8 windows/step
     sps_8 = run(8, 1)  # 8 devices x 1 window/step, pmean over the mesh
     speedup = sps_8 / sps_1 if sps_1 > 0 else 0.0
-    # WEAK-scaling curve at fixed windows/device (8), n = 1/2/4/8 devices.
-    # On a virtual mesh the devices share the host's core(s), so wall-clock
-    # weak scaling is bounded at 1/n by construction; the transferable
-    # quantity is PROGRAM efficiency: n-device sharded throughput vs ONE
-    # device running the same total windows per step unsharded. That ratio
-    # isolates what sharding costs — partitioning, psum collectives,
-    # per-device dispatch (ideal 1.0). On real chips each device brings its
-    # own compute, so this same program shape IS the weak-scaling step and
-    # the ratio here is the efficiency to expect (BASELINE.json north star:
-    # scaling eff 1→8→32).
-    per_dev = 8
-    weak = {}
-    for n in (2, 4, 8):
-        sps_unsharded = run(1, per_dev * n)  # same total work, no mesh
-        sps_sharded = run(n, per_dev)        # n devices x 8 windows each
-        weak[str(n)] = {
-            "global_batch": per_dev * n,
-            "steps_per_sec_1dev_unsharded": round(sps_unsharded, 2),
-            f"steps_per_sec_{n}dev_sharded": round(sps_sharded, 2),
-            "program_efficiency": round(
-                sps_sharded / sps_unsharded if sps_unsharded > 0 else 0.0, 3
-            ),
-        }
+    # Sharding overhead at the weak-scaling program shape: 8 devices x 8
+    # windows/step vs ONE device running the same 64-window step unsharded.
+    # That ratio isolates what sharding costs — partitioning, psum
+    # collectives, per-device dispatch (ideal 1.0); on real chips each
+    # device brings its own compute, so this program shape IS the
+    # weak-scaling step. On the virtual mesh all devices share one host
+    # core, and single-shot readings produced "efficiencies" of 0.69–1.16
+    # for the SAME program across r3/r4 captures (XLA:CPU batch
+    # nonlinearity + host noise) — so this is measured as the MEDIAN of
+    # interleaved replicas with the spread reported, and the per-device
+    # n=2/4 curve points (which only re-sampled the same noise) are gone
+    # (VERDICT r4).
+    reps = 3
+    unsharded: list[float] = []
+    sharded: list[float] = []
+    for _ in range(reps):  # interleave sides so host drift hits both
+        unsharded.append(run(1, 64))
+        sharded.append(run(8, 8))
+    med_u = statistics.median(unsharded)
+    med_s = statistics.median(sharded)
     print(
         json.dumps(
             {
@@ -186,19 +197,27 @@ def _scaling_child() -> None:
                     "speedup_8dev": round(speedup, 3),
                     "efficiency": round(speedup / 8.0, 3),
                 },
-                "weak_fixed_windows_per_device": {
-                    "windows_per_device": per_dev,
-                    "by_devices": weak,
-                },
-                # r3 alias: the n=8 weak point is the same-total-work
-                # sharding-overhead measurement previous rounds reported.
                 "sharding_overhead_same_total_work": {
                     "global_batch": 64,
-                    "steps_per_sec_1dev": weak["8"][
-                        "steps_per_sec_1dev_unsharded"
+                    "replicas": reps,
+                    "steps_per_sec_1dev_unsharded": [
+                        round(v, 2) for v in unsharded
                     ],
-                    "steps_per_sec_8dev": weak["8"]["steps_per_sec_8dev_sharded"],
-                    "ratio_8dev_vs_1dev": weak["8"]["program_efficiency"],
+                    "steps_per_sec_8dev_sharded": [
+                        round(v, 2) for v in sharded
+                    ],
+                    "median_1dev": round(med_u, 2),
+                    "median_8dev": round(med_s, 2),
+                    "ratio_8dev_vs_1dev": round(
+                        med_s / med_u if med_u > 0 else 0.0, 3
+                    ),
+                    # Conservative interval: worst and best replica pairing.
+                    "ratio_bounds": [
+                        round(min(sharded) / max(unsharded), 3)
+                        if max(unsharded) > 0 else 0.0,
+                        round(max(sharded) / min(unsharded), 3)
+                        if min(unsharded) > 0 else 0.0,
+                    ],
                 },
             }
         )
@@ -218,8 +237,8 @@ def _run_scaling_subprocess() -> dict | None:
         out = subprocess.run(
             [sys.executable, __file__, "--scaling-child"],
             env=env,
-            # 8 CPU-mesh fits (strong pair + 3-point weak curve, sharded
-            # and unsharded sides).
+            # 8 CPU-mesh fits (strong pair + 3 replicas of the sharded and
+            # unsharded sharding-overhead sides).
             timeout=3000,
             check=True,
             capture_output=True,
@@ -276,14 +295,26 @@ def _point_child(objective: str, batch_size: int, epochs: int) -> None:
 
 
 def _measure_point(
-    objective: str, batch_size: int, epochs: int, timeout_s: float
+    objective: str, batch_size: int, epochs: int, timeout_s: float,
+    force_cpu: bool = False,
 ) -> dict | None:
-    """Watchdogged measurement; None on hang/crash (logged, never raised)."""
+    """Watchdogged measurement; None on hang/crash (logged, never raised).
+
+    ``force_cpu`` pins the child to the CPU backend the only reliable way —
+    via its environment, before its jax import — so the degraded fallback
+    can never touch (and hang on) the wedged relay (ADVICE r4).
+    """
+    env = None
+    if force_cpu:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
     try:
         out = subprocess.run(
             [sys.executable, __file__, "--point", objective,
              str(batch_size), str(epochs)],
             cwd=Path(__file__).resolve().parent,
+            env=env,
             timeout=timeout_s,
             capture_output=True,
             text=True,
@@ -334,24 +365,35 @@ def main() -> None:
         )
         if headline is None:
             degraded = True
-            os.environ["JAX_PLATFORMS"] = "cpu"
+            _pin_cpu_in_process()
 
     # CPU fallback is ~300x slower per step: trim the measurement window so
-    # the run still finishes inside a driver timeout. Measured in-process —
-    # the CPU backend cannot wedge.
+    # the run still finishes inside a driver timeout. Measured in a
+    # force_cpu subprocess (a mid-measurement wedge in the parent's backend
+    # state can't leak into a child whose env pins CPU before jax imports);
+    # in-process only as a last resort, with the platform pinned.
     measure_epochs = 2 if degraded else MEASURE_EPOCHS
     if degraded:
-        dm1 = FinancialWindowDataModule(
-            data_dir, lookback_window=60, target_window=30, stride=90,
-            batch_size=1,
+        point = _measure_point(
+            "mse", 1, measure_epochs, POINT_TIMEOUT_AUX_S, force_cpu=True
         )
-        dm1.prepare_data(verbose=False)
-        dm1.setup()
-        value = _measure(dm1, "mse", measure_epochs)
-        windows_per_epoch = len(dm1.train_range)
-        import jax
+        if point is not None:
+            value = point["steps_per_sec"]
+            windows_per_epoch = point["windows_per_epoch"]
+            platform = point["platform"]
+        else:
+            _pin_cpu_in_process()
+            dm1 = FinancialWindowDataModule(
+                data_dir, lookback_window=60, target_window=30, stride=90,
+                batch_size=1,
+            )
+            dm1.prepare_data(verbose=False)
+            dm1.setup()
+            value = _measure(dm1, "mse", measure_epochs)
+            windows_per_epoch = len(dm1.train_range)
+            import jax
 
-        platform = jax.devices()[0].platform
+            platform = jax.devices()[0].platform
     else:
         value = headline["steps_per_sec"]
         windows_per_epoch = headline["windows_per_epoch"]

@@ -27,7 +27,12 @@ import numpy as np
 
 from masters_thesis_tpu.data.fama_french import FamaFrench25Portfolios
 from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
-from masters_thesis_tpu.utils import atomic_publish, atomic_write_text, wait_until
+from masters_thesis_tpu.utils import (
+    atomic_publish,
+    atomic_write_text,
+    multihost_rank,
+    wait_until,
+)
 from masters_thesis_tpu.ops import (
     add_quadratic_features,
     lookback_target_split,
@@ -103,9 +108,11 @@ def bootstrap_synthetic(
             "delete the directory to regenerate"
         )
 
-    import jax
-
-    if jax.process_count() > 1 and jax.process_index() != 0:
+    # multihost_rank (not jax.process_count) keeps single-host bootstrap off
+    # the device backend entirely — a parent process bootstrapping data must
+    # not take the one-per-process TPU relay lease as a side effect.
+    rank, world = multihost_rank()
+    if world > 1 and rank != 0:
         # Shared dir: wait for process 0's marker; host-local: generate.
         if wait_until(check_existing, 600.0):
             return
@@ -250,8 +257,6 @@ class FinancialWindowDataModule:
         concurrent duplicate build harmless). The hash file is written AFTER
         the dataset, so readers never observe a torn cache.
         """
-        import jax
-
         hparams_hash = self._hparams_hash()
         self._datasets_dir.mkdir(parents=True, exist_ok=True)
         hash_file = self._datasets_dir / "hparams_hash.txt"
@@ -268,7 +273,8 @@ class FinancialWindowDataModule:
             if verbose:
                 print("Dataset parameters unchanged, skipping data preparation")
             return
-        if jax.process_count() > 1 and jax.process_index() != 0:
+        rank, world = multihost_rank()  # backend-free: see bootstrap_synthetic
+        if world > 1 and rank != 0:
             if wait_until(cache_ready, cache_timeout_s):
                 return
             if verbose:

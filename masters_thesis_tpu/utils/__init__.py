@@ -2,6 +2,7 @@
 
 from masters_thesis_tpu.utils.backend_probe import (
     ProbeResult,
+    multihost_rank,
     probe_tpu_backend,
 )
 from masters_thesis_tpu.utils.compilation_cache import (
@@ -18,6 +19,7 @@ __all__ = [
     "atomic_publish",
     "atomic_write_text",
     "enable_persistent_compilation_cache",
+    "multihost_rank",
     "probe_tpu_backend",
     "wait_until",
 ]

@@ -38,6 +38,25 @@ class ProbeResult:
     detail: str  # "" when ok; reason + child stderr tail otherwise
 
 
+def multihost_rank() -> tuple[int, int]:
+    """(process_index, process_count) WITHOUT initializing the XLA backend.
+
+    ``jax.process_count()`` forces device-backend init; on the relay-attached
+    TPU that makes the calling process take the single relay lease as a side
+    effect of a host-side bookkeeping question, after which any measurement
+    subprocess it spawns contends with it (documented UNAVAILABLE crash +
+    wedge risk, docs/OPERATIONS.md). Multi-process runs in this framework
+    always go through ``parallel.mesh.distributed_initialize`` (which calls
+    ``jax.distributed.initialize``), so an uninitialized distributed client
+    proves the run is single-process — answerable with no backend touch.
+    """
+    import jax
+
+    if not jax.distributed.is_initialized():
+        return 0, 1
+    return jax.process_index(), jax.process_count()
+
+
 def probe_tpu_backend(
     timeout_s: float = DEFAULT_TIMEOUT_S,
     budget_s: float = 0.0,

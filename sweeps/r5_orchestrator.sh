@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-5 TPU orchestrator. The r4 lesson (docs/OPERATIONS.md): the relay
+# may give ONE healthy window all round — when it opens, capture every
+# queued on-chip deliverable, cheapest-evidence first, before the
+# long-running grid takes the chip.
+#
+# Queue (VERDICT r4 "Next round" items, cheap->expensive):
+#   1. check_timeblocked_tpu.py  — the only kernel with zero Mosaic evidence
+#   2. check_stack_tpu.py        — re-gate the wavefront stack
+#   3. bench.py                  — fresh TPU headline + regenerate the
+#                                  last_tpu_measurement cache (reset wiped it)
+#   4. bench_fused_pair.py       — per-model wavefront A/B table
+#   5. profile_breakdown.py      — step-time attribution trace
+#   6. run_grid_canonical.py     — warmup/scratch cells, then slowest column
+#
+# Timeouts are generous backstops sized never to fire in a healthy run —
+# SIGKILLing a TPU-attached child is the suspected r4 wedge trigger.
+# State goes to results/R5_STATE so the operator knows when the chip (and
+# the single host core) is in use: no heavy CPU work while state != wait.
+cd /root/repo || exit 1
+STATE=results/R5_STATE
+GRID_DEADLINE="2026-08-01T01:45"
+
+state() { echo "$1" > "$STATE"; echo "$(date -u +%H:%M:%S) state: $1"; }
+
+CREATED_PAUSE=0
+if [ ! -f results/PAUSE ]; then
+  touch results/PAUSE
+  CREATED_PAUSE=1
+fi
+trap '[ "$CREATED_PAUSE" = 1 ] && rm -f results/PAUSE; echo done > "$STATE"' EXIT
+
+state wait
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) relay wedged; retry in 240s"
+  sleep 240
+done
+echo "$(date -u +%H:%M:%S) relay healthy"
+
+while pgrep -f "python train.py" > /dev/null 2>&1; do
+  echo "$(date -u +%H:%M:%S) train.py holds the chip; waiting 120s"
+  sleep 120
+done
+
+state gates
+echo "== time-blocked kernel Mosaic gate (first ever on-chip run) =="
+timeout 1800 python sweeps/check_timeblocked_tpu.py 2>&1 | tee results/check_timeblocked_r5.log
+echo "== stack wavefront Mosaic gate =="
+timeout 1200 python sweeps/check_stack_tpu.py 2>&1 | tee results/check_stack_r5.log
+
+state bench
+echo "== fresh bench capture =="
+# Backstop must EXCEED bench.py's internal watchdog worst case (~600s
+# probe + 1200s headline + 3x700s aux + 3000s scaling ≈ 6900s): a fired
+# outer timeout SIGTERMs only the parent python, orphaning a TPU-attached
+# watchdog grandchild that then contends with the next queue stage for
+# the one relay lease (code review r5).
+timeout 7500 python bench.py > results/bench_r5_tpu.json 2> results/bench_r5_tpu.log
+tail -c 400 results/bench_r5_tpu.json
+
+state ab_sweep
+echo "== wavefront A/B sweep =="
+timeout 5400 python sweeps/bench_fused_pair.py 2>&1 | tee results/bench_fused_r5.log
+
+state profile
+echo "== profile breakdown =="
+timeout 2400 python sweeps/profile_breakdown.py 2>&1 | tee results/profile_r5.log
+
+# Hand the chip to the grid: it has its own probe/pause/deadline logic.
+# Only lift a PAUSE this script created — an operator's pre-existing hold
+# stays theirs to lift (code review r5).
+if [ "$CREATED_PAUSE" = 1 ]; then
+  rm -f results/PAUSE
+fi
+CREATED_PAUSE=0
+state grid
+python sweeps/run_grid_canonical.py --deadline "$GRID_DEADLINE" \
+  > results/grid_r5_runner.log 2>&1
+state done
+echo "$(date -u +%H:%M:%S) round-5 TPU queue complete"

@@ -200,7 +200,14 @@ def ensure_checkpoint(
     comparison from under-trained pretrain weights would silently
     invalidate it. Completion is recorded in a marker file next to the
     checkpoint (same lifetime: both live in logs/, both die in a reset);
-    the checkpoint protocol never touches foreign files in its dir."""
+    the checkpoint protocol never touches foreign files in its dir.
+
+    INTENTIONAL (ADVICE r4): a complete checkpoint written before the
+    marker protocol (or by an older runner) is relaunched once rather than
+    trusted — the marker is the only completion evidence with checkpoint
+    lifetime, and ``trainer.resume=true`` makes that relaunch exit almost
+    immediately when the checkpoint really was complete, so the cost is
+    bounded startup churn, not a retrain."""
     marker = ckpt.parent / f"{ckpt.name}.ENSURED"
     if ckpt.exists() and marker.exists():
         return True
