@@ -85,16 +85,23 @@ def _ensure_responsive_backend() -> tuple[bool, int]:
     return True, probe.attempts
 
 
+def _pin_cpu(env: dict) -> dict:
+    """The one CPU-pinning incantation: JAX_PLATFORMS alone is NOT enough —
+    the relay plugin trigger env must go too or the axon sitecustomize
+    re-selects the TPU plugin regardless (ADVICE r4)."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def _pin_cpu_in_process() -> None:
     """Force THIS process onto the CPU backend, even after ``import jax``.
 
     JAX captures ``JAX_PLATFORMS`` at import time, so the env var alone is
     not enough once anything has imported jax (ADVICE r4); the config update
-    is what actually pins the platform pre-init, and the relay plugin
-    trigger env must go too or it re-selects the TPU plugin regardless.
+    is what actually pins the platform pre-init.
     """
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _pin_cpu(os.environ)
     import jax
 
     try:
@@ -225,11 +232,7 @@ def _scaling_child() -> None:
 
 
 def _run_scaling_subprocess() -> dict | None:
-    env = dict(os.environ)
-    # The TPU-relay plugin trigger would override JAX_PLATFORMS=cpu in the
-    # child (and contend for the one relay session); strip it.
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _pin_cpu(dict(os.environ))
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
@@ -304,11 +307,7 @@ def _measure_point(
     via its environment, before its jax import — so the degraded fallback
     can never touch (and hang on) the wedged relay (ADVICE r4).
     """
-    env = None
-    if force_cpu:
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
+    env = _pin_cpu(dict(os.environ)) if force_cpu else None
     try:
         out = subprocess.run(
             [sys.executable, __file__, "--point", objective,

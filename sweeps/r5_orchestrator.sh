@@ -17,9 +17,13 @@
 # SIGKILLing a TPU-attached child is the suspected r4 wedge trigger.
 # State goes to results/R5_STATE so the operator knows when the chip (and
 # the single host core) is in use: no heavy CPU work while state != wait.
+# An abnormal exit leaves state=interrupted (NOT done): a TERMed script's
+# foreground child may still hold the chip, so the operator must check
+# for survivors before assuming the core is free.
 cd /root/repo || exit 1
 STATE=results/R5_STATE
 GRID_DEADLINE="2026-08-01T01:45"
+FINISHED=0
 
 state() { echo "$1" > "$STATE"; echo "$(date -u +%H:%M:%S) state: $1"; }
 
@@ -28,22 +32,34 @@ if [ ! -f results/PAUSE ]; then
   touch results/PAUSE
   CREATED_PAUSE=1
 fi
-trap '[ "$CREATED_PAUSE" = 1 ] && rm -f results/PAUSE; echo done > "$STATE"' EXIT
+on_exit() {
+  [ "$CREATED_PAUSE" = 1 ] && rm -f results/PAUSE
+  if [ "$FINISHED" = 1 ]; then echo done > "$STATE"; else echo interrupted > "$STATE"; fi
+}
+trap on_exit EXIT
 
 state wait
+# ORDER MATTERS (one TPU process at a time): an in-flight train.py cell
+# owns both the chip and the relay — probing the relay while it runs
+# crashes both with UNAVAILABLE. Wait out any cell FIRST, then probe.
+while pgrep -f "python train.py" > /dev/null 2>&1; do
+  echo "$(date -u +%H:%M:%S) train.py holds the chip; waiting 120s"
+  sleep 120
+done
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     break
   fi
   echo "$(date -u +%H:%M:%S) relay wedged; retry in 240s"
   sleep 240
+  # A cell could in principle appear while we slept (grid runner from a
+  # prior round); re-assert exclusivity before the next probe.
+  while pgrep -f "python train.py" > /dev/null 2>&1; do
+    echo "$(date -u +%H:%M:%S) train.py holds the chip; waiting 120s"
+    sleep 120
+  done
 done
-echo "$(date -u +%H:%M:%S) relay healthy"
-
-while pgrep -f "python train.py" > /dev/null 2>&1; do
-  echo "$(date -u +%H:%M:%S) train.py holds the chip; waiting 120s"
-  sleep 120
-done
+echo "$(date -u +%H:%M:%S) relay healthy; chip free; starting TPU queue"
 
 state gates
 echo "== time-blocked kernel Mosaic gate (first ever on-chip run) =="
@@ -79,5 +95,5 @@ CREATED_PAUSE=0
 state grid
 python sweeps/run_grid_canonical.py --deadline "$GRID_DEADLINE" \
   > results/grid_r5_runner.log 2>&1
-state done
+FINISHED=1
 echo "$(date -u +%H:%M:%S) round-5 TPU queue complete"

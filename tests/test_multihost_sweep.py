@@ -1,0 +1,129 @@
+"""Multi-host sweep sharding x multi-device mesh, end to end.
+
+``partition_jobs`` is unit-tested (tests/test_config.py) and 2-process DDP
+is integration-tested (tests/test_distributed.py); this closes the last
+untested composition (VERDICT r4 #7): FOUR separate OS processes — one per
+"host" of a pod — each running the SAME ``train.py -m`` sweep command with
+its own ``MT_HOST_INDEX``, each on its own 2-virtual-device CPU mesh
+(strategy=auto picks the sharded tpu_xla path), writing into one shared
+sweep tree. The multi-host contract under test (reference parity:
+Hydra's joblib launcher fans a sweep across GPU processes,
+reference: configs/config.yaml:6,17-19):
+
+- every host takes exactly its round-robin share of the sweep (4 jobs /
+  4 hosts = 1 each, by GLOBAL sweep index),
+- numbered job dirs are collision-free fleet-wide (0..3, one per job,
+  each with Hydra-compatible .hydra metadata and a completed checkpoint),
+- concurrent hosts bootstrapping one shared data_dir rendezvous through
+  the atomic-publish marker protocol instead of corrupting each other.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.slow  # 4 concurrent training processes, ~2-4 min
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+
+NUM_HOSTS = 4
+SWEEP = [
+    "loss=mse,nll",
+    "model.hidden_size=8,12",  # 2x2 = 4 sweep points
+    "model.num_layers=1",
+    "trainer=fast",
+    "trainer.max_epochs=1",
+    # progress bar ON: the "mesh: 2xdata | tpu_xla" summary line asserted
+    # below prints through the progress-gated _print path.
+    "trainer.enable_progress_bar=true",
+    "datamodule.n_samples=8000",
+    "datamodule.n_stocks=4",
+]
+
+
+def _host_env(host_index: int, sweep_dir: Path, data_dir: Path) -> dict:
+    env = os.environ.copy()
+    # Hermetic from the TPU relay; a 2-device virtual mesh per host.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = str(_REPO_ROOT)
+    env["MT_HOST_INDEX"] = str(host_index)
+    env["MT_NUM_HOSTS"] = str(NUM_HOSTS)
+    env["MT_SWEEP_DIR"] = str(sweep_dir)
+    return env
+
+
+def test_four_host_sweep_shard_end_to_end(tmp_path):
+    sweep_dir = tmp_path / "sweep"
+    data_dir = tmp_path / "data"  # SHARED: all hosts bootstrap it at once
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "train.py", "-m", *SWEEP,
+                f"datamodule.data_dir={data_dir}",
+            ],
+            cwd=_REPO_ROOT,
+            env=_host_env(h, sweep_dir, data_dir),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for h in range(NUM_HOSTS)
+    ]
+    outs = []
+    try:
+        for h, p in enumerate(procs):
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, f"host {h} failed:\n{out[-3000:]}"
+    finally:
+        # A failed/timed-out host must not leak the others: they would keep
+        # training on the single host core for minutes after the test died.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    # Each host announced and ran exactly its 1/4 share.
+    for h, out in enumerate(outs):
+        assert f"multirun: host {h}/{NUM_HOSTS} takes 1/4 jobs" in out, (
+            f"host {h} took the wrong share:\n{out[-1500:]}"
+        )
+        # strategy=auto saw the 2-device mesh and took the sharded path.
+        assert "| mesh: 2xdata | tpu_xla" in out, (
+            f"host {h} did not run on the 2-device mesh:\n{out[-1500:]}"
+        )
+
+    # Collision-free numbered job dirs: every global sweep index exactly
+    # once, each with Hydra-style metadata and a COMPLETED run.
+    job_dirs = sorted(d.name for d in sweep_dir.iterdir() if d.is_dir())
+    assert job_dirs == [str(i) for i in range(NUM_HOSTS)]
+    seen_points = set()
+    for i in range(NUM_HOSTS):
+        job_dir = sweep_dir / str(i)
+        overrides = yaml.safe_load(
+            (job_dir / ".hydra" / "overrides.yaml").read_text()
+        )
+        point = tuple(
+            ov for ov in overrides
+            if ov.startswith(("loss=", "model.hidden_size="))
+        )
+        seen_points.add(point)
+        ckpts = list(job_dir.glob("logs/**/checkpoints/best"))
+        assert ckpts, f"job {i} left no checkpoint under {job_dir}"
+        assert list(job_dir.glob("logs/**/checkpoints/last.json")), (
+            f"job {i} run did not complete"
+        )
+    # The 4 job dirs cover the full 2x2 cartesian sweep, no duplicates.
+    assert len(seen_points) == NUM_HOSTS
+
+    # The shared bootstrap rendezvous left ONE coherent dataset.
+    assert (data_dir / "dgp.json").exists()
+    assert (data_dir / "stocks.npy").exists()
