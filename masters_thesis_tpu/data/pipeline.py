@@ -196,6 +196,13 @@ class FinancialWindowDataModule:
     def n_features(self) -> int:
         return 3 if self.interaction_only else 5
 
+    @property
+    def n_stocks(self) -> int | None:
+        """Stocks per window (the LSTM kernel's row count), once ``setup``
+        has loaded the arrays; None before that."""
+        arrays = getattr(self, "_arrays", None)
+        return None if arrays is None else int(arrays.x.shape[1])
+
     def _hparams_hash(self) -> str:
         """SHA-256 over the window hyperparameters AND a source fingerprint.
 

@@ -626,6 +626,76 @@ def pair_rows_ok(b: int, n_t: int = 60, hidden: int = 64) -> bool:
     return pair_fits(n_t, b, hidden)
 
 
+# Shape classes where the bf16 stack wavefront MEASURED faster on real TPU
+# than the f32 pair default (sweeps/bench_fused_pair.py A/B; RESULTS.md
+# "precision defaults" table). An entry (min_layers, hidden) qualifies
+# every model with that hidden size and at least that many layers. EMPTY
+# until the hardware A/B records the win — ``precision=auto`` then keeps
+# the reference-parity f32 numerics everywhere; flipping a shape class in
+# is a one-line change backed by a measured row.
+MEASURED_BF16_WAVEFRONT_WINS: tuple[tuple[int, int], ...] = ()
+
+
+def max_wavefront_depth(
+    n_t: int, b: int, hidden: int, n_layers: int,
+    has_mask: bool = True, itemsize: int = 4,
+) -> int:
+    """Deepest fused wavefront the VMEM byte model admits for this shape."""
+    depth = 1
+    while depth < n_layers and stack_fits(
+        n_t, b, hidden, depth + 1, has_mask, itemsize
+    ):
+        depth += 1
+    return depth
+
+
+def preferred_compute_dtype(
+    num_layers: int, hidden: int, n_t: int = 60, rows: int = 100,
+    kernel_impl: str = "auto", backend: str | None = None,
+):
+    """Resolve ``precision=auto`` for one model shape.
+
+    bf16 compute halves every VMEM stash plane, which can admit a strictly
+    deeper wavefront (shorter serial recurrence chain — the measured
+    latency lever, RESULTS.md). Auto picks bfloat16 only when ALL hold:
+
+    - the fused wavefront path will actually run — Pallas-capable
+      ``kernel_impl``, fusion + wavefront kill-switches on, TPU backend
+      (the scan fallback has no VMEM wavefront, so flipping numerics
+      there buys nothing),
+    - the byte model says bf16 unlocks depth this f32 shape can't reach,
+    - the shape class has a measured on-TPU win recorded in
+      ``MEASURED_BF16_WAVEFRONT_WINS`` (defaults are flipped by evidence,
+      not by the model alone).
+
+    Everything else keeps float32 — the reference-parity numerics
+    (reference: train.py:13 pins only torch's matmul precision; this is a
+    measured, shape-aware policy instead).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    qualifies = any(
+        num_layers >= min_layers and hidden == h
+        for min_layers, h in MEASURED_BF16_WAVEFRONT_WINS
+    )
+    if not qualifies:
+        return jnp.float32
+    if kernel_impl not in ("auto", "pallas", "interpret"):
+        return jnp.float32
+    if not (pair_fusion_enabled() and wavefront_enabled()):
+        return jnp.float32
+    if (backend or jax.default_backend()) != "tpu":
+        return jnp.float32
+    # `rows` is the kernel's leading dim — stocks per window (canonical
+    # 100), NOT the optimizer batch: window-granular scheduling runs one
+    # window's rows per fused program regardless of batch_size.
+    unlocks = max_wavefront_depth(
+        n_t, rows, hidden, num_layers, True, 2
+    ) > max_wavefront_depth(n_t, rows, hidden, num_layers, True, 4)
+    return jnp.bfloat16 if unlocks else jnp.float32
+
+
 def pair_fusion_enabled() -> bool:
     """Kill-switch for the fused layer-pair kernel (MT_LSTM_FUSED_PAIR=0).
 

@@ -67,6 +67,8 @@ def _precision_dtype(precision: str):
         return jnp.float32
     if precision in ("bf16-mixed", "bf16"):
         return jnp.bfloat16
+    if precision == "auto":
+        return None  # resolved per model shape at fit/test time
     raise ValueError(f"unknown precision: {precision!r}")
 
 
@@ -91,6 +93,9 @@ class Trainer:
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
+        # 'auto' defers the dtype to the per-shape measured policy
+        # (ops.lstm_kernel.preferred_compute_dtype) once the model and
+        # window shapes are known at fit/test time.
         self.compute_dtype = _precision_dtype(precision)
         self.check_val_every_n_epoch = max(1, int(check_val_every_n_epoch))
         if strategy == "auto":
@@ -109,6 +114,23 @@ class Trainer:
         self.seed = seed
         self.name = name
         self.resume = resume
+
+    def _resolve_dtype(self, spec, dm):
+        """Concrete compute dtype for this (model, window) shape.
+
+        ``precision=auto`` resolves through the measured per-shape policy:
+        bf16 only where the VMEM byte model shows it unlocks a deeper
+        wavefront AND the A/B recorded the win on hardware
+        (ops.lstm_kernel.MEASURED_BF16_WAVEFRONT_WINS)."""
+        if self.compute_dtype is not None:
+            return self.compute_dtype
+        from masters_thesis_tpu.ops.lstm_kernel import preferred_compute_dtype
+
+        return preferred_compute_dtype(
+            spec.num_layers, spec.hidden_size, dm.lookback_window,
+            getattr(dm, "n_stocks", None) or 100,
+            kernel_impl=spec.kernel_impl,
+        )
 
     # ----------------------------------------------------------- data prep
 
@@ -173,7 +195,7 @@ class Trainer:
         dm.prepare_data(verbose=self.enable_progress_bar)
         dm.setup("fit")
 
-        module = spec.build_module(compute_dtype=self.compute_dtype)
+        module = spec.build_module(compute_dtype=self._resolve_dtype(spec, dm))
         init_rng, dropout_rng = jax.random.split(jax.random.key(self.seed))
         dummy = jnp.zeros(
             (1, dm.lookback_window, dm.n_features), jnp.float32
@@ -485,7 +507,7 @@ class Trainer:
         """Final test metrics: MAE + NLL + MSE + objective total
         (reference: trainer.test at train.py:198 -> src/model.py:119-141)."""
         dm.setup("test")
-        module = spec.build_module(compute_dtype=self.compute_dtype)
+        module = spec.build_module(compute_dtype=self._resolve_dtype(spec, dm))
         eval_fn = make_eval_fn(module, spec.window_objective(), self.mesh)
         prepared = self._eval_split(dm.test_arrays())
         if prepared is None:

@@ -47,7 +47,7 @@ SWEEP = [
 ]
 
 
-def _host_env(host_index: int, sweep_dir: Path, data_dir: Path) -> dict:
+def _host_env(host_index: int, sweep_dir: Path) -> dict:
     env = os.environ.copy()
     # Hermetic from the TPU relay; a 2-device virtual mesh per host.
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -70,7 +70,7 @@ def test_four_host_sweep_shard_end_to_end(tmp_path):
                 f"datamodule.data_dir={data_dir}",
             ],
             cwd=_REPO_ROOT,
-            env=_host_env(h, sweep_dir, data_dir),
+            env=_host_env(h, sweep_dir),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
