@@ -70,3 +70,52 @@ def test_measure_point_none_on_garbage_stdout(bench, monkeypatch, capsys):
     )
     assert bench._measure_point("mse", 8, 4, 60.0) is None
     assert "no JSON" in capsys.readouterr().err
+
+
+def _tpu_line(value: float) -> str:
+    return json.dumps(
+        {"value": value, "detail": {"device": "tpu"}}
+    )
+
+
+def test_carry_prefers_the_live_cache(bench, tmp_path):
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({"measured_at": "t", "value": 5306.0}))
+    (tmp_path / "bench_r4_tpu.json").write_text(_tpu_line(1.0))
+    carried = bench._carry_last_tpu(cache, tmp_path)
+    assert carried["value"] == 5306.0
+
+
+def test_carry_falls_back_to_committed_artifacts(bench, tmp_path):
+    # No cache (environment reset wiped data/): the newest committed
+    # healthy-TPU artifact is carried, labeled with its source.
+    (tmp_path / "bench_r4_tpu.json").write_text(_tpu_line(5306.0))
+    carried = bench._carry_last_tpu(tmp_path / "missing.json", tmp_path)
+    assert carried["carried_from"] == "results/bench_r4_tpu.json"
+    assert carried["value"] == 5306.0
+    # A newer round's artifact wins when present.
+    (tmp_path / "bench_r5_tpu.json").write_text(_tpu_line(6000.0))
+    carried = bench._carry_last_tpu(tmp_path / "missing.json", tmp_path)
+    assert carried["carried_from"] == "results/bench_r5_tpu.json"
+
+
+def test_carry_skips_degraded_and_corrupt_artifacts(bench, tmp_path):
+    # A CPU-fallback line (device != tpu), a torn file, and parseable
+    # non-dict JSON ('null') are all skipped without an exception — the
+    # one-JSON-line invariant survives any artifact content.
+    (tmp_path / "bench_r6_tpu.json").write_text("null")
+    (tmp_path / "bench_r5_tpu.json").write_text(
+        json.dumps({"value": 13.8, "detail": {"device": "cpu"}})
+    )
+    (tmp_path / "bench_r4_tpu.json").write_text("{torn")
+    assert bench._carry_last_tpu(tmp_path / "missing.json", tmp_path) is None
+
+
+def test_carry_discovers_future_round_artifacts(bench, tmp_path):
+    # Next round's artifact (r10, numerically > r9) wins without bench.py
+    # edits, and a non-dict cache falls through to the artifacts.
+    (tmp_path / "cache.json").write_text("null")
+    (tmp_path / "bench_r9_tpu.json").write_text(_tpu_line(1.0))
+    (tmp_path / "bench_r10_tpu.json").write_text(_tpu_line(2.0))
+    carried = bench._carry_last_tpu(tmp_path / "cache.json", tmp_path)
+    assert carried["carried_from"] == "results/bench_r10_tpu.json"
