@@ -39,10 +39,22 @@ on_exit() {
 trap on_exit EXIT
 
 state wait
+# A train.py whose cmdline carries "midscale" is the CPU-pinned,
+# nice-19 insurance runner (sweeps/run_warmup_cpu_midscale.py) — it never
+# touches the relay and must NOT starve heal detection. Only relay-backed
+# cells (everything else) demand exclusivity.
+tpu_train_running() {
+  for pid in $(pgrep -f "python train.py" 2>/dev/null); do
+    if ! tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null | grep -q midscale; then
+      return 0
+    fi
+  done
+  return 1
+}
 # ORDER MATTERS (one TPU process at a time): an in-flight train.py cell
 # owns both the chip and the relay — probing the relay while it runs
 # crashes both with UNAVAILABLE. Wait out any cell FIRST, then probe.
-while pgrep -f "python train.py" > /dev/null 2>&1; do
+while tpu_train_running; do
   echo "$(date -u +%H:%M:%S) train.py holds the chip; waiting 120s"
   sleep 120
 done
@@ -54,7 +66,7 @@ while true; do
   sleep 240
   # A cell could in principle appear while we slept (grid runner from a
   # prior round); re-assert exclusivity before the next probe.
-  while pgrep -f "python train.py" > /dev/null 2>&1; do
+  while tpu_train_running; do
     echo "$(date -u +%H:%M:%S) train.py holds the chip; waiting 120s"
     sleep 120
   done

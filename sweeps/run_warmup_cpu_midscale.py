@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Reduced-scale warmup-vs-scratch insurance run on the CPU backend.
+
+The thesis' headline protocol (synthetic pretrain -> fine-tune beats
+scratch training; reference: tex/diplomski_rad.tex:1134-1147, 1170-1174)
+is queued for the canonical 1M-sample capture on the TPU
+(sweeps/run_grid_canonical.py) — but the relay can stay wedged for an
+entire round (it did in r4). This runner reproduces the SAME protocol at
+1/20th scale (50k-sample bootstrap, model=small) on the CPU backend so
+the round has a real measured ordering even if the chip never comes back.
+Rows land in results/warmup_cpu_midscale.jsonl, clearly labeled with
+their scale — they never touch the canonical grid results.
+
+Chip-politeness contract (docs/OPERATIONS.md): every training child runs
+``nice -n 19`` with the CPU platform pinned, and the runner exits BEFORE
+launching the next cell the moment results/R5_STATE leaves "wait" (the
+TPU orchestrator owns the host core from that point).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+OUT = REPO / "results" / "warmup_cpu_midscale.jsonl"
+STATE = REPO / "results" / "R5_STATE"
+
+N_SAMPLES = 50_000
+LOSSES = ("mse", "nll", "combined")
+SCALE_META = {
+    "scale": "cpu_midscale_1_20th",
+    "n_samples": N_SAMPLES,
+    "model": "small",
+    "trainer": "slow",
+    "device": "cpu",
+}
+
+SYN_DIR = "data/midscale_synthetic"
+OUT_DIR = "data/midscale_outliers"
+PRETRAIN_VERSION = "combined_small_lr0.0001_slow"
+PRETRAIN_CKPT = (
+    REPO / "logs/FinancialLstm/midscale_syn" / PRETRAIN_VERSION
+    / "checkpoints/best"
+)
+
+
+def log(msg: str) -> None:
+    print(f"{datetime.datetime.now():%H:%M:%S} {msg}", flush=True)
+
+
+def cpu_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def tpu_queue_active() -> bool:
+    try:
+        return STATE.read_text().strip() != "wait"
+    except OSError:
+        return False  # no orchestrator running: the core is ours
+
+
+def done_cells() -> set:
+    if not OUT.exists():
+        return set()
+    return {
+        json.loads(line)["cell"]
+        for line in OUT.read_text().splitlines()
+        if line.strip()
+    }
+
+
+def run_child(
+    args: list[str], timeout_s: float, check: bool = False
+) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["nice", "-n", "19", sys.executable, *args],
+        cwd=REPO,
+        env=cpu_env(),
+        timeout=timeout_s,
+        check=check,
+        capture_output=True,
+        text=True,
+    )
+
+
+def train_cell(cell: str, overrides: list[str], timeout_s: float) -> bool:
+    log(f"train {cell}")
+    t0 = time.time()
+    try:
+        out = run_child(
+            ["train.py", *overrides, "trainer.resume=true",
+             "trainer.enable_progress_bar=false",
+             "trainer.enable_model_summary=false"],
+            timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"{cell}: timed out after {timeout_s:.0f}s (resume continues it)")
+        return False
+    if out.returncode != 0:
+        log(f"{cell}: FAILED rc={out.returncode}\n{out.stdout[-800:]}\n"
+            f"{out.stderr[-800:]}")
+        return False
+    log(f"{cell}: trained in {time.time() - t0:.0f}s")
+    return True
+
+
+def record_cell(cell: str, ckpt: Path, eval_overrides: list[str],
+                wall_s: float) -> None:
+    try:
+        ev = run_child(
+            ["sweeps/eval_cell.py", f"checkpoint={ckpt}", *eval_overrides],
+            1800,
+            check=True,
+        )
+        row = json.loads(ev.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001 - log and move on; cell rerunnable
+        # TimeoutExpired/CalledProcessError carry the child's stderr (None
+        # when nothing was captured); other exceptions carry none at all.
+        stderr = getattr(exc, "stderr", None) or ""
+        log(f"{cell}: eval failed ({type(exc).__name__}) {stderr[-500:]}")
+        return
+    row.update({"cell": cell, "train_wall_s": round(wall_s, 1), **SCALE_META})
+    OUT.parent.mkdir(exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    log(f"{cell}: recorded")
+
+
+def run_and_record(cell: str, train_ov: list[str], ckpt: Path,
+                   eval_ov: list[str], timeout_s: float = 3600) -> bool:
+    if cell in done_cells():
+        log(f"skip {cell}: already recorded")
+        return True
+    if tpu_queue_active():
+        log("TPU queue active (R5_STATE != wait); yielding the core")
+        raise SystemExit(0)
+    t0 = time.time()
+    if not train_cell(cell, train_ov, timeout_s):
+        return False
+    if not ckpt.exists():
+        log(f"{cell}: no checkpoint at {ckpt}")
+        return False
+    record_cell(cell, ckpt, eval_ov, time.time() - t0)
+    return True
+
+
+def main() -> None:
+    base = [
+        "model=small", "trainer=slow",
+        f"datamodule.n_samples={N_SAMPLES}",
+    ]
+    syn_ov = [f"datamodule.data_dir={SYN_DIR}",
+              "logger.name=FinancialLstm/midscale_syn"]
+    out_ov = ["datamodule.dgp_variant=outliers",
+              f"datamodule.data_dir={OUT_DIR}",
+              "logger.name=FinancialLstm/midscale_out"]
+
+    # 1. Pretrain on the base synthetic DGP (the warmup source weights).
+    pretrain_ov = ["loss=combined", *base, *syn_ov]
+    ok = run_and_record(
+        "mid_pretrain_combined_small",
+        pretrain_ov,
+        PRETRAIN_CKPT,
+        [f"datamodule.data_dir={SYN_DIR}",
+         f"datamodule.n_samples={N_SAMPLES}"],
+    )
+    # Recorded-but-missing checkpoint (environment resets wipe logs/ while
+    # the results JSONL is committed): retrain to completion WITHOUT
+    # re-recording — the recorded metrics stand, only the weights the
+    # warmup block warm-starts from are restored (same rationale as
+    # run_grid_canonical.ensure_checkpoint).
+    if ok and not PRETRAIN_CKPT.exists():
+        if tpu_queue_active():
+            log("TPU queue active before pretrain ensure; yielding the core")
+            raise SystemExit(0)
+        log("pretrain recorded but checkpoint missing; retraining (not "
+            "re-recorded)")
+        ok = train_cell("mid_pretrain_ensure", pretrain_ov, 3600)
+
+    # 2. From-scratch baselines on the fine-tune (outliers) dataset.
+    for loss in LOSSES:
+        run_and_record(
+            f"mid_outliers_{loss}_small_scratch",
+            [f"loss={loss}", *base, *out_ov],
+            REPO / "logs/FinancialLstm/midscale_out"
+            / f"{loss}_small_lr0.0001_slow/checkpoints/best",
+            ["datamodule.dgp_variant=outliers",
+             f"datamodule.data_dir={OUT_DIR}",
+             f"datamodule.n_samples={N_SAMPLES}"],
+        )
+
+    # 3. Warm-started cells (pretrained weights, fresh optimizer).
+    if ok and PRETRAIN_CKPT.exists():
+        warm_name = "logger.name=FinancialLstm/midscale_warm"
+        for loss in LOSSES:
+            run_and_record(
+                f"mid_outliers_{loss}_small_warmup",
+                [f"loss={loss}", *base, *out_ov[:-1], warm_name,
+                 f"checkpoint={PRETRAIN_CKPT}", "checkpoint_mode=params"],
+                REPO / "logs/FinancialLstm/midscale_warm"
+                / f"{loss}_small_lr0.0001_slow/checkpoints/best",
+                ["datamodule.dgp_variant=outliers",
+                 f"datamodule.data_dir={OUT_DIR}",
+                 f"datamodule.n_samples={N_SAMPLES}"],
+            )
+    else:
+        log("warmup cells skipped: pretrain checkpoint unavailable")
+    log("midscale runner finished")
+
+
+if __name__ == "__main__":
+    main()
