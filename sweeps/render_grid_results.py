@@ -14,17 +14,19 @@ import json
 import sys
 from pathlib import Path
 
-OUT = Path(__file__).resolve().parent.parent / "results" / "grid_r3.jsonl"
+RESULTS_DIR = Path(__file__).resolve().parent.parent / "results"
+OUT = RESULTS_DIR / "grid_r3.jsonl"
+MIDSCALE = RESULTS_DIR / "warmup_cpu_midscale.jsonl"
 
 
-def load_cells() -> tuple[dict, float]:
+def load_cells(path: Path) -> tuple[dict, float]:
     """(last row per cell, total wall across ALL rows — truncated runs that
     were later resumed each contributed real compute)."""
     cells: dict = {}
     total_wall = 0.0
-    if not OUT.exists():
+    if not path.exists():
         return cells, total_wall
-    for line in OUT.read_text().splitlines():
+    for line in path.read_text().splitlines():
         if line.strip():
             row = json.loads(line)
             cells[row["cell"]] = row  # last row per cell wins
@@ -40,8 +42,44 @@ def fmt(row: dict, who: str) -> str:
     )
 
 
+def warmup_table(cells: dict, prefix: str, model_size: str,
+                 header: str) -> bool:
+    """Scratch-vs-warmup comparison (the thesis' headline protocol,
+    tex/diplomski_rad.tex:1134-1147): for each objective on the fine-tune
+    dataset, from-scratch training vs warm-started from the
+    synthetic-pretrained weights, plus the OLS baseline on that data.
+    Prints ``header`` + table only when at least one pair exists; returns
+    whether anything rendered (no orphan headers)."""
+    pairs = []
+    for loss in ("mse", "nll", "combined"):
+        scratch = cells.get(f"{prefix}{loss}_{model_size}_scratch")
+        warm = cells.get(f"{prefix}{loss}_{model_size}_warmup")
+        if scratch or warm:
+            pairs.append((loss, scratch, warm))
+    if not pairs:
+        return False
+    print(header)
+    print("\n| Objective | ΔL_MIX scratch | ΔL_MIX warmup | ΔL_MIX OLS | "
+          "warmup wins? |")
+    print("|---|---|---|---|---|")
+    for loss, scratch, warm in pairs:
+        s = scratch["model"]["delta_mix"] if scratch else None
+        w = warm["model"]["delta_mix"] if warm else None
+        ols = (scratch or warm)["ols"]["delta_mix"]
+        verdict = (
+            "?" if s is None or w is None
+            else ("yes" if w < s else "no")
+        )
+        print(
+            f"| {loss} | {s if s is None else f'{s:.3f}'} | "
+            f"{w if w is None else f'{w:.3f}'} | {ols:.3f} | "
+            f"{verdict} |"
+        )
+    return True
+
+
 def main() -> None:
-    cells, total_wall = load_cells()
+    cells, total_wall = load_cells(OUT)
     if not cells:
         sys.exit("no recorded cells")
 
@@ -60,34 +98,22 @@ def main() -> None:
           "(all runs incl. resumed); truncated: "
           f"{sum(1 for r in cells.values() if r.get('truncated'))}")
 
-    # Scratch-vs-warmup comparison (the thesis' headline protocol,
-    # tex/diplomski_rad.tex:1134-1147): for each objective on the
-    # fine-tune dataset, from-scratch training vs warm-started from the
-    # synthetic-pretrained weights, plus the OLS baseline on that data.
-    pairs = []
-    for loss in ("mse", "nll", "combined"):
-        scratch = cells.get(f"outliers_{loss}_large_scratch")
-        warm = cells.get(f"outliers_{loss}_large_warmup")
-        if scratch or warm:
-            pairs.append((loss, scratch, warm))
-    if pairs:
-        print("\n### Warmup protocol (fine-tune dataset: outliers DGP)\n")
-        print("| Objective | ΔL_MIX scratch | ΔL_MIX warmup | ΔL_MIX OLS | "
-              "warmup wins? |")
-        print("|---|---|---|---|---|")
-        for loss, scratch, warm in pairs:
-            s = scratch["model"]["delta_mix"] if scratch else None
-            w = warm["model"]["delta_mix"] if warm else None
-            ols = (scratch or warm)["ols"]["delta_mix"]
-            verdict = (
-                "?" if s is None or w is None
-                else ("yes" if w < s else "no")
-            )
-            print(
-                f"| {loss} | {s if s is None else f'{s:.3f}'} | "
-                f"{w if w is None else f'{w:.3f}'} | {ols:.3f} | "
-                f"{verdict} |"
-            )
+    warmup_table(
+        cells, "outliers_", "large",
+        "\n### Warmup protocol (fine-tune dataset: outliers DGP)",
+    )
+
+    # CPU insurance capture of the same protocol at 1/20th scale
+    # (sweeps/run_warmup_cpu_midscale.py) — rendered separately and
+    # clearly labeled; never mixed with the canonical rows.
+    mid_cells, mid_wall = load_cells(MIDSCALE)
+    if warmup_table(
+        mid_cells, "mid_outliers_", "small",
+        "\n### Warmup protocol at 1/20th scale "
+        "(CPU insurance capture: 50k-sample bootstrap, model=small)",
+    ):
+        print(f"\n{len(mid_cells)} midscale cells; total train wall "
+              f"{mid_wall / 3600:.2f}h on the CPU backend")
 
 
 if __name__ == "__main__":

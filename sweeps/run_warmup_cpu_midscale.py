@@ -33,11 +33,13 @@ STATE = REPO / "results" / "R5_STATE"
 
 N_SAMPLES = 50_000
 LOSSES = ("mse", "nll", "combined")
+# NB: keys here must not collide with eval_cell.py's row schema —
+# "model" there is the model's ΔL dict, hence "model_size".
 SCALE_META = {
     "scale": "cpu_midscale_1_20th",
     "n_samples": N_SAMPLES,
-    "model": "small",
-    "trainer": "slow",
+    "model_size": "small",
+    "trainer_preset": "slow",
     "device": "cpu",
 }
 

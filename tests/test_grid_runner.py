@@ -252,10 +252,47 @@ def test_renderer_warmup_table(monkeypatch, tmp_path, capsys):
         row("outliers_nll_large_scratch", 1000.0, 1100.0),  # warmup pending
     ]))
     monkeypatch.setattr(mod, "OUT", out)
+    # Hermetic from the repo's real midscale insurance results.
+    monkeypatch.setattr(mod, "MIDSCALE", tmp_path / "absent.jsonl")
     mod.main()
     text = capsys.readouterr().out
     assert "| mse | 2139.000 | 2050.000 | 2299.000 | yes |" in text
     assert "| nll | 1000.000 | None | 1100.000 | ? |" in text
+
+
+def test_renderer_midscale_section(monkeypatch, tmp_path, capsys):
+    """Midscale insurance rows render in their own clearly-labeled table,
+    never mixed into the canonical one."""
+    spec = importlib.util.spec_from_file_location(
+        "_renderer_mid", _REPO_ROOT / "sweeps" / "render_grid_results.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def row(cell, mix_model, mix_ols):
+        return {
+            "cell": cell, "epoch": 31, "train_wall_s": 60.0,
+            "model": {"delta_mse": 1e-2, "delta_nll": 1.0,
+                      "delta_mix": mix_model},
+            "ols": {"delta_mse": 2e-2, "delta_nll": 2.0,
+                    "delta_mix": mix_ols},
+        }
+
+    canonical = tmp_path / "grid.jsonl"
+    canonical.write_text(json.dumps(row("mse_small_slow", 1.0, 2.0)) + "\n")
+    mid = tmp_path / "mid.jsonl"
+    mid.write_text("".join(json.dumps(r) + "\n" for r in [
+        row("mid_outliers_mse_small_scratch", 300.0, 400.0),
+        row("mid_outliers_mse_small_warmup", 250.0, 400.0),
+    ]))
+    monkeypatch.setattr(mod, "OUT", canonical)
+    monkeypatch.setattr(mod, "MIDSCALE", mid)
+    mod.main()
+    text = capsys.readouterr().out
+    assert "1/20th scale" in text
+    assert "| mse | 300.000 | 250.000 | 400.000 | yes |" in text
+    # No canonical warmup section: no scratch/warmup cells in the grid.
+    assert "fine-tune dataset: outliers DGP" not in text
 
 
 def test_train_with_retry_truncates_on_timeout(runner, monkeypatch):
