@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Epoch-scale bf16-vs-f32 training parity check.
+
+The bf16 stack wavefront is the measured latency lever for deep models
+(RESULTS.md), but flipping ``precision`` defaults needs evidence that
+bf16 COMPUTE (f32 params/loss math, ops/lstm_kernel.py) does not bend the
+training trajectory at epoch scale — the reference's entire precision
+story is one global ``torch.set_float32_matmul_precision('medium')``
+(reference: train.py:13) with no such check at all.
+
+Trains the same cell twice (32-true vs bf16-mixed), compares the
+validation-loss trajectory and final best-val, prints ONE JSON line:
+``{"parity": bool, "rel_final_gap": float, "curve": {...}}``. Parity =
+final best-val relative gap under --tolerance (default 2%).
+
+Runs on whatever backend the environment provides: the CPU backend at
+reduced scale is the wedged-relay insurance capture; the TPU at canonical
+scale is the real deliverable. Device is recorded in the JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def run_one(precision: str, args) -> dict:
+    import math
+
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.train import Trainer
+
+    data_dir = REPO / args.data_dir
+    bootstrap_synthetic(
+        data_dir, n_stocks=100, n_samples=args.n_samples, seed=0
+    )
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=60, target_window=30, stride=90,
+        batch_size=1,
+    )
+    trainer = Trainer(
+        max_epochs=args.epochs,
+        gradient_clip_val=2.0,  # trainer=slow preset
+        precision=precision,
+        # Never larger than the epoch budget, or no val point ever fires
+        # and best_val stays inf.
+        check_val_every_n_epoch=min(4, args.epochs),
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+    )
+    result = trainer.fit(ModelSpec(objective=args.loss), dm)
+    val_curve = [
+        h["loss/total/val"] for h in result.history
+        if h.get("loss/total/val") is not None
+    ]
+    # A halted/diverged run (the exact failure this check exists to catch)
+    # must fail parity outright, not sneak through on an early good val.
+    diverged = any(
+        not math.isfinite(h.get("loss/total/train", 0.0))
+        for h in result.history
+    ) or not math.isfinite(result.best_val_loss)
+    return {
+        "best_val": result.best_val_loss,
+        "val_curve": val_curve,
+        "diverged": diverged,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n-samples", type=int, default=50_000)
+    parser.add_argument("--epochs", type=int, default=32)
+    parser.add_argument(
+        "--loss", default="mse",
+        help="mse is the meaningful parity objective (strictly positive "
+        "losses); nll/combined values can cross zero, where a relative "
+        "gap overstates divergence — gaps are computed against "
+        "max(|f32|, 1e-6) to stay finite either way",
+    )
+    parser.add_argument(
+        "--data-dir", default=None,
+        help="defaults to data/parity_<n_samples> (a dataset dir is "
+        "pinned to one generation config; 50k reuses the midscale "
+        "runner's cache)",
+    )
+    parser.add_argument("--tolerance", type=float, default=0.02)
+    args = parser.parse_args()
+    if args.data_dir is None:
+        args.data_dir = (
+            "data/midscale_synthetic" if args.n_samples == 50_000
+            else f"data/parity_{args.n_samples}"
+        )
+
+    f32 = run_one("32-true", args)
+    bf16 = run_one("bf16-mixed", args)
+
+    def rel(b: float, f: float) -> float:
+        return abs(b - f) / max(abs(f), 1e-6)
+
+    rel_gap = rel(bf16["best_val"], f32["best_val"])
+    curve_gaps = [
+        rel(b, f) for b, f in zip(bf16["val_curve"], f32["val_curve"])
+    ]
+    # Unequal curve lengths mean one run halted early — that is itself a
+    # parity failure, and zip() must not silently hide it.
+    lengths_match = len(bf16["val_curve"]) == len(f32["val_curve"])
+    clean = not (f32["diverged"] or bf16["diverged"]) and lengths_match
+    import math
+
+    import jax
+
+    def js(v):
+        """Non-finite floats (diverged runs) become null, keeping the one
+        output line strict JSON."""
+        return v if isinstance(v, (int, float)) and math.isfinite(v) else None
+
+    print(json.dumps({
+        "parity": bool(clean and rel_gap < args.tolerance),
+        "diverged": {"f32": f32["diverged"], "bf16": bf16["diverged"]},
+        "rel_final_gap": js(round(rel_gap, 5)),
+        "f32_best_val": js(f32["best_val"]),
+        "bf16_best_val": js(bf16["best_val"]),
+        "max_curve_rel_gap": (
+            js(round(max(curve_gaps), 5)) if curve_gaps else None
+        ),
+        "val_points": [len(f32["val_curve"]), len(bf16["val_curve"])],
+        "epochs": args.epochs,
+        "n_samples": args.n_samples,
+        "loss": args.loss,
+        "device": jax.devices()[0].platform,
+    }, allow_nan=False))
+
+
+if __name__ == "__main__":
+    main()
