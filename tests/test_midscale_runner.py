@@ -1,0 +1,100 @@
+"""Control logic of the CPU midscale insurance runner.
+
+The training itself is exercised by the live runs; these pin the pieces
+that decide WHETHER and WHAT to run: core-yield behavior against the
+orchestrator state file, recorded-cell resume, and the metadata schema
+staying disjoint from eval_cell's row keys (a collision silently
+overwrote the model ΔL dict once — caught in round 5)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "_midscale", _REPO_ROOT / "sweeps" / "run_warmup_cpu_midscale.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_scale_meta_never_collides_with_eval_row_schema():
+    mod = _load()
+    eval_row_keys = {
+        "checkpoint", "objective", "num_layers", "epoch", "val_loss",
+        "zeta", "model", "ols", "baseline",  # sweeps/eval_cell.py output
+        "cell", "train_wall_s",              # added by the runner itself
+    }
+    collisions = eval_row_keys & set(mod.SCALE_META)
+    assert not collisions, (
+        f"SCALE_META keys {collisions} would overwrite eval row fields "
+        "on record_cell's row.update"
+    )
+
+
+def test_yields_core_whenever_orchestrator_is_not_waiting(
+    monkeypatch, tmp_path
+):
+    mod = _load()
+    state = tmp_path / "R5_STATE"
+    monkeypatch.setattr(mod, "STATE", state)
+    # No orchestrator at all: the core is ours.
+    assert not mod.tpu_queue_active()
+    state.write_text("wait\n")
+    assert not mod.tpu_queue_active()
+    # Any other phase — including a crashed orchestrator whose children
+    # may still hold the chip — means hands off the core.
+    for phase in ("gates", "bench", "grid", "done", "interrupted"):
+        state.write_text(phase)
+        assert mod.tpu_queue_active(), phase
+
+
+def test_done_cells_reads_last_rows(monkeypatch, tmp_path):
+    mod = _load()
+    out = tmp_path / "mid.jsonl"
+    monkeypatch.setattr(mod, "OUT", out)
+    assert mod.done_cells() == set()
+    out.write_text(
+        json.dumps({"cell": "a"}) + "\n" + json.dumps({"cell": "b"}) + "\n"
+    )
+    assert mod.done_cells() == {"a", "b"}
+
+
+def test_run_and_record_skips_recorded_and_yields_when_active(
+    monkeypatch, tmp_path
+):
+    mod = _load()
+    out = tmp_path / "mid.jsonl"
+    out.write_text(json.dumps({"cell": "done_cell"}) + "\n")
+    monkeypatch.setattr(mod, "OUT", out)
+    state = tmp_path / "R5_STATE"
+    monkeypatch.setattr(mod, "STATE", state)
+    trained = []
+    monkeypatch.setattr(
+        mod, "train_cell", lambda cell, ov, t: trained.append(cell) or True
+    )
+    monkeypatch.setattr(
+        mod, "record_cell", lambda *a, **k: None
+    )
+
+    # Recorded: skipped without training — even while the TPU queue is
+    # active (the skip check must run before the yield check, or resumed
+    # runs would die on their first recorded cell).
+    state.write_text("bench")
+    assert mod.run_and_record("done_cell", [], tmp_path / "x", [])
+    assert trained == []
+
+    # Unrecorded but TPU queue active: exits instead of training.
+    try:
+        mod.run_and_record("fresh_cell", [], tmp_path / "x", [])
+    except SystemExit as exc:
+        assert exc.code == 0
+    else:  # pragma: no cover - the yield MUST raise
+        raise AssertionError("runner did not yield the core")
+    assert trained == []
