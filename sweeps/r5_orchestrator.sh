@@ -22,7 +22,7 @@
 # for survivors before assuming the core is free.
 cd /root/repo || exit 1
 STATE=results/R5_STATE
-GRID_DEADLINE="2026-08-01T01:45"
+GRID_DEADLINE="2026-08-01T04:30"
 FINISHED=0
 
 state() { echo "$1" > "$STATE"; echo "$(date -u +%H:%M:%S) state: $1"; }

@@ -8,6 +8,7 @@ overwrote the model ΔL dict once — caught in round 5)."""
 
 from __future__ import annotations
 
+import ast
 import importlib.util
 import json
 from pathlib import Path
@@ -24,12 +25,38 @@ def _load():
     return mod
 
 
+def _eval_cell_row_keys() -> set[str]:
+    """The keys of eval_cell.py's output row, read from its source.
+
+    Parsed from the dict literal inside the ``json.dumps(...)`` call (the
+    module itself imports the heavy jax stack, so importing it here would
+    drag TPU/compile costs into a schema check). Parsing the source keeps
+    the collision guard honest: a key added to eval_cell.py shows up here
+    without anyone remembering to update a hardcoded copy."""
+    tree = ast.parse((_REPO_ROOT / "sweeps" / "eval_cell.py").read_text())
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and getattr(node.func, "attr", "") == "dumps"
+            and node.args
+            and isinstance(node.args[0], ast.Dict)
+        ):
+            keys = {
+                k.value
+                for k in node.args[0].keys
+                if isinstance(k, ast.Constant)
+            }
+            # Sanity floor so a refactor that empties the literal (or a
+            # second json.dumps appearing first) fails loudly, not green.
+            assert {"checkpoint", "model", "ols"} <= keys, keys
+            return keys
+    raise AssertionError("eval_cell.py row dict literal not found")
+
+
 def test_scale_meta_never_collides_with_eval_row_schema():
     mod = _load()
-    eval_row_keys = {
-        "checkpoint", "objective", "num_layers", "epoch", "val_loss",
-        "zeta", "model", "ols", "baseline",  # sweeps/eval_cell.py output
-        "cell", "train_wall_s",              # added by the runner itself
+    eval_row_keys = _eval_cell_row_keys() | {
+        "cell", "train_wall_s",  # added by the runner itself
     }
     collisions = eval_row_keys & set(mod.SCALE_META)
     assert not collisions, (
