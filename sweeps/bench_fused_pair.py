@@ -30,6 +30,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 MODEL_LAYERS = {"small": 2, "medium": 4, "large": 8}
 
@@ -58,7 +59,9 @@ def child(mode: str, model: str) -> None:
     for key in ("MT_LSTM_FUSED_PAIR", "MT_LSTM_WAVEFRONT"):
         if key in cfg:
             os.environ[key] = cfg[key]
-    sys.path.insert(0, str(REPO))
+    from masters_thesis_tpu.utils import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     from masters_thesis_tpu.data.pipeline import (
         FinancialWindowDataModule,
         bootstrap_synthetic,
@@ -96,21 +99,36 @@ def child(mode: str, model: str) -> None:
 
 
 def main() -> None:
+    # A wedged relay would otherwise cost 900s PER CHILD x 12 points; probe
+    # once up front (retrying through a transient wedge) and bail with an
+    # explicit line so the orchestrator's next stage gets its own chance.
+    from masters_thesis_tpu.utils import probe_tpu_backend
+
+    probe = probe_tpu_backend(timeout_s=90.0, budget_s=1200.0)
+    if not probe.ok:
+        print(f"backend probe failed: {probe.detail}; skipping the A/B sweep",
+              flush=True)
+        return
     models = sys.argv[1:] or list(MODEL_LAYERS)
     rows = []
     for model in models:
         for mode in MODES:
             t0 = time.time()
+            # Sized for a COLD persistent cache (environment resets wipe
+            # ~/.cache): a healthy cold epoch-program compile through the
+            # relay has run past 1200s, and SIGKILLing a healthy TPU child
+            # is the documented wedge trigger (docs/OPERATIONS.md).
+            cap_s = 1800
             try:
                 out = subprocess.run(
                     [sys.executable, __file__, "--child", mode, model],
-                    cwd=REPO, timeout=900, capture_output=True, text=True,
+                    cwd=REPO, timeout=cap_s, capture_output=True, text=True,
                 )
             except subprocess.TimeoutExpired:
                 # A starved host or wedged relay must cost this POINT, not
                 # the whole sweep (observed: a 1-core host under concurrent
                 # load pushed one child past its cap and killed the run).
-                print(f"[{model} {mode}] TIMEOUT after 900s; skipping",
+                print(f"[{model} {mode}] TIMEOUT after {cap_s}s; skipping",
                       flush=True)
                 continue
             if out.returncode != 0:

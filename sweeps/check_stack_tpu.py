@@ -28,6 +28,10 @@ from masters_thesis_tpu.ops.lstm_kernel import (
     stack_fits,
 )
 
+# NO persistent compile cache here (unlike bench/profile): this gate's
+# reported compile_s must measure a real Mosaic compile, not cache
+# deserialization, and exercising that compile IS the gate.
+
 
 def main() -> None:
     n_t, b, hidden, ell = 60, 100, 64, 4

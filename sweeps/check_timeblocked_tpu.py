@@ -31,6 +31,10 @@ from masters_thesis_tpu.ops.lstm_kernel import (
     single_layer_fits,
 )
 
+# NO persistent compile cache here (unlike bench/profile): this gate's
+# reported compile_s must measure a real Mosaic compile, not cache
+# deserialization, and exercising that compile IS the gate.
+
 
 def main() -> None:
     # T=1024 at 104 rows/H=64 f32: the full (T, B, 4H) + state planes are

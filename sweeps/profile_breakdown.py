@@ -46,6 +46,10 @@ def _probe_backend_or_exit() -> None:
 
 _probe_backend_or_exit()
 
+from masters_thesis_tpu.utils import enable_persistent_compilation_cache
+
+enable_persistent_compilation_cache()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -82,16 +82,16 @@ timeout 1200 python sweeps/check_stack_tpu.py 2>&1 | tee results/check_stack_r5.
 state bench
 echo "== fresh bench capture =="
 # Backstop must EXCEED bench.py's internal watchdog worst case (~600s
-# probe + 1200s headline + 3x700s aux + 3000s scaling ≈ 6900s): a fired
+# probe + 2400s headline + 3x700s aux + 3000s scaling ≈ 8100s): a fired
 # outer timeout SIGTERMs only the parent python, orphaning a TPU-attached
 # watchdog grandchild that then contends with the next queue stage for
 # the one relay lease (code review r5).
-timeout 7500 python bench.py > results/bench_r5_tpu.json 2> results/bench_r5_tpu.log
+timeout 8700 python bench.py > results/bench_r5_tpu.json 2> results/bench_r5_tpu.log
 tail -c 400 results/bench_r5_tpu.json
 
 state ab_sweep
 echo "== wavefront A/B sweep =="
-timeout 5400 python sweeps/bench_fused_pair.py 2>&1 | tee results/bench_fused_r5.log
+timeout 7200 python sweeps/bench_fused_pair.py 2>&1 | tee results/bench_fused_r5.log
 
 state profile
 echo "== profile breakdown =="
