@@ -218,6 +218,15 @@ def test_ab_sweep_survives_child_timeout(monkeypatch, capsys):
             stderr="",
         )
 
+    # The up-front backend probe (added r5) spawns its own subprocess via
+    # the SHARED subprocess module — stub it out so the fake below only
+    # ever sees --child invocations.
+    import masters_thesis_tpu.utils as mt_utils
+
+    monkeypatch.setattr(
+        mt_utils, "probe_tpu_backend",
+        lambda **kw: types.SimpleNamespace(ok=True, attempts=1, detail=""),
+    )
     monkeypatch.setattr(mod.subprocess, "run", fake_child)
     monkeypatch.setattr(mod.sys, "argv", ["bench_fused_pair.py", "small"])
     mod.main()
@@ -225,6 +234,35 @@ def test_ab_sweep_survives_child_timeout(monkeypatch, capsys):
     assert "TIMEOUT" in out and "skipping" in out
     assert calls == list(mod.MODES)  # every point attempted
     assert '"mode": "pair"' in out  # surviving points still reported
+
+
+def test_ab_sweep_skips_whole_run_when_probe_fails(monkeypatch, capsys):
+    """A wedged relay must cost a bounded probe, not 12 x per-child cap:
+    the sweep bails before spawning any child (r5: twelve 900s SIGKILLs
+    against a wedged lease, each kill itself a wedge trigger)."""
+    spec = importlib.util.spec_from_file_location(
+        "_fused_bench", _REPO_ROOT / "sweeps" / "bench_fused_pair.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    import masters_thesis_tpu.utils as mt_utils
+
+    monkeypatch.setattr(
+        mt_utils, "probe_tpu_backend",
+        lambda **kw: types.SimpleNamespace(
+            ok=False, attempts=5, detail="probe timed out (wedged lease)"
+        ),
+    )
+
+    def no_children(*a, **k):  # pragma: no cover - the bail must prevent this
+        raise AssertionError("probe failed but a child was spawned")
+
+    monkeypatch.setattr(mod.subprocess, "run", no_children)
+    monkeypatch.setattr(mod.sys, "argv", ["bench_fused_pair.py"])
+    mod.main()
+    out = capsys.readouterr().out
+    assert "skipping the A/B sweep" in out
 
 
 def test_renderer_warmup_table(monkeypatch, tmp_path, capsys):

@@ -75,11 +75,66 @@ def test_yields_core_whenever_orchestrator_is_not_waiting(
     assert not mod.tpu_queue_active()
     state.write_text("wait\n")
     assert not mod.tpu_queue_active()
-    # Any other phase — including a crashed orchestrator whose children
-    # may still hold the chip — means hands off the core.
-    for phase in ("gates", "bench", "grid", "done", "interrupted"):
+    # Measurement phases own the core unconditionally (their children are
+    # timeout-capped; contention can kill a healthy TPU child).
+    monkeypatch.setattr(mod, "_tpu_process_alive", lambda: False)
+    for phase in ("gates", "bench", "ab_sweep", "profile"):
         state.write_text(phase)
         assert mod.tpu_queue_active(), phase
+    # grid/done/interrupted defer to the live process table: a relay-backed
+    # process running means yield; an idle wedge-wait means the core is
+    # ours (r5: the state sat at "grid" for hours of wedge).
+    for phase in ("grid", "done", "interrupted"):
+        state.write_text(phase)
+        assert not mod.tpu_queue_active(), phase
+    monkeypatch.setattr(mod, "_tpu_process_alive", lambda: True)
+    for phase in ("grid", "done", "interrupted"):
+        state.write_text(phase)
+        assert mod.tpu_queue_active(), phase
+
+
+def test_tpu_process_scan_filters_self_and_supervisors(monkeypatch):
+    """The /proc scan must key on comm==python*: supervisors whose argv
+    merely EMBEDS script names (the session driver's prompt text contains
+    'train.py') must not read as relay-backed processes — and this
+    runner's own midscale children must be filtered."""
+    mod = _load()
+
+    fake = {
+        "1": ("claude", "claude -p ... python train.py bench.py ..."),
+        "2": ("python3", "python train.py trainer=slow midscale marker"),
+        "3": ("python3", "python -c import jax; jax.devices()"),
+    }
+
+    class FakeEntry:
+        def __init__(self, name):
+            self.name = name
+
+        def __truediv__(self, part):
+            return FakeFile(self.name, part)
+
+    class FakeFile:
+        def __init__(self, pid, part):
+            self.pid, self.part = pid, part
+
+        def read_text(self):
+            return fake[self.pid][0]
+
+        def read_bytes(self):
+            return fake[self.pid][1].encode()
+
+    class FakeProc:
+        def iterdir(self):
+            return [FakeEntry(k) for k in fake]
+
+    real_path = mod.Path
+    monkeypatch.setattr(
+        mod, "Path",
+        lambda p="": FakeProc() if p == "/proc" else real_path(p),
+    )
+    assert not mod._tpu_process_alive()
+    fake["4"] = ("python3", "/opt/venv/bin/python /root/repo/train.py x")
+    assert mod._tpu_process_alive()
 
 
 def test_done_cells_reads_last_rows(monkeypatch, tmp_path):
