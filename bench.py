@@ -357,6 +357,18 @@ def _measure_point(
 
 
 def main() -> None:
+    if "--preflight" in sys.argv:
+        # Gate the benchmark on the tracelint trace-time audit: a recompile
+        # / transfer / sharding regression makes every number below
+        # meaningless, so fail loudly before burning the measurement budget.
+        from masters_thesis_tpu.analysis.findings import format_report
+        from masters_thesis_tpu.analysis.traceaudit import run_trace_audit
+
+        findings = run_trace_audit()
+        if findings:
+            print(format_report(findings), file=sys.stderr)
+            sys.exit(2)
+        print("preflight: trace audit ok", file=sys.stderr)
     degraded, probe_attempts = _ensure_responsive_backend()
     from masters_thesis_tpu.data.pipeline import (
         FinancialWindowDataModule,

@@ -1,0 +1,40 @@
+"""tracelint — static + trace-time analysis for the TPU hot path.
+
+The framework's performance contract is "one jitted shard_map+scan program
+per epoch" (train/steps.py). That contract degrades *silently*: a stray
+``float()`` on a tracer forces a host sync, a reused PRNG key correlates
+dropout masks, an f64 literal promotes the whole loss graph, a host
+transfer inside the loop serializes every step on the relay link, and a
+bad sharding annotation turns the gradient psum into an all-gather. None
+of those raise; they just make training slow or subtly wrong.
+
+Two cooperating passes enforce the contract:
+
+- **Pass 1 — AST lint** (:mod:`astlint`): repo-specific rules over the
+  package source, driven by a jit-reachability call graph
+  (:mod:`callgraph`) so host-side code is not held to trace-time rules.
+- **Pass 2 — trace-time audit** (:mod:`traceaudit`): builds the real
+  train-epoch program from a small config, runs it, and asserts the
+  compiled-artifact invariants — compile count stays 1 across steps,
+  ``jax.transfer_guard("disallow")`` holds over the hot loop, the batch
+  axis is sharded / params replicated, and dtypes match the precision
+  policy.
+
+CLI: ``python -m masters_thesis_tpu.analysis`` (exits non-zero on
+findings). The trainer runs Pass 2 before ``fit`` when constructed with
+``preflight=True``.
+"""
+
+from masters_thesis_tpu.analysis.findings import (
+    Finding,
+    RULES,
+    format_report,
+)
+from masters_thesis_tpu.analysis.astlint import lint_paths
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "format_report",
+    "lint_paths",
+]

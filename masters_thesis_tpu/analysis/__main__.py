@@ -1,0 +1,97 @@
+"""``python -m masters_thesis_tpu.analysis`` — run tracelint.
+
+Pass 1 (AST lint) over the given paths (default: the installed package),
+then Pass 2 (trace-time audit) on a hermetic 8-device virtual CPU mesh.
+Exits non-zero iff there are findings, so it gates CI (tools/check.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+
+def _force_cpu_mesh(n_devices: int) -> None:
+    """Pin the audit to a virtual CPU mesh regardless of ambient
+    accelerators/plugins — the audited invariants are properties of the
+    traced program, and CI machines differ."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    # An ambient PJRT plugin (e.g. a TPU proxy) overrides JAX_PLATFORMS
+    # set this late; the config update wins as long as no backend has
+    # been initialized yet in this process.
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m masters_thesis_tpu.analysis",
+        description="tracelint: static + trace-time TPU hot-path analysis",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        type=Path,
+        help="files/directories to lint (default: the package source)",
+    )
+    parser.add_argument(
+        "--skip-trace",
+        action="store_true",
+        help="run only Pass 1 (AST lint), skip the trace-time audit",
+    )
+    parser.add_argument(
+        "--skip-lint",
+        action="store_true",
+        help="run only Pass 2 (trace-time audit)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    parser.add_argument(
+        "--trace-steps",
+        type=int,
+        default=3,
+        metavar="N",
+        help="epochs the trace audit runs through the compiled program",
+    )
+    parser.add_argument(
+        "--trace-devices",
+        type=int,
+        default=8,
+        metavar="N",
+        help="virtual CPU devices for the audit mesh",
+    )
+    args = parser.parse_args(argv)
+
+    import masters_thesis_tpu
+
+    package_root = Path(masters_thesis_tpu.__file__).parent
+    paths = args.paths or [package_root]
+
+    findings = []
+    if not args.skip_lint:
+        from masters_thesis_tpu.analysis.astlint import lint_paths
+
+        findings.extend(lint_paths(paths, package_root=package_root))
+    if not args.skip_trace:
+        _force_cpu_mesh(args.trace_devices)
+        from masters_thesis_tpu.analysis.traceaudit import run_trace_audit
+
+        findings.extend(run_trace_audit(steps=args.trace_steps))
+
+    from masters_thesis_tpu.analysis.findings import format_report
+
+    print(format_report(findings, as_json=args.json))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,585 @@
+"""Pass 1 — AST lint with repo-specific TPU hot-path rules.
+
+Rules (ids registered in :mod:`findings`):
+
+- **TL101** tracer leaked to a host cast: ``float()``/``int()``/``bool()``/
+  ``.item()``/``.tolist()`` applied to a traced value inside jit-reachable
+  code. Forces a device sync at trace time (or a ConcretizationTypeError).
+- **TL102** Python control flow on a traced value: ``if``/``while`` whose
+  condition computes a jnp/jax expression, or ``for`` iterating a jnp/jax
+  call, inside jit-reachable code. Either crashes at trace time or unrolls/
+  retraces per value.
+- **TL103** PRNG key reuse: the same key consumed by two sampling calls
+  (or by a sampler inside a loop the key doesn't vary over) without an
+  intervening ``split``/``fold_in``. Correlated randomness, silently.
+- **TL104** f64 literal / x64 enablement: ``float64`` dtypes and
+  ``jax_enable_x64`` promote the whole graph off the MXU fast path.
+- **TL105** host transfer in jit-reachable code: ``jax.device_get``/
+  ``jax.device_put``, ``np.*`` on traced values, ``block_until_ready``.
+
+"Jit-reachable" comes from :mod:`callgraph`: functions passed to / decorated
+with JIT wrappers, plus everything they transitively call within the linted
+sources. Host-side code (the trainer loop, checkpointing, benchmarking) is
+deliberately exempt from TL101/TL102/TL105 — host casts and transfers are
+its job there.
+
+Value tracking is a per-function taint pass: parameters and results of
+jnp/jax calls are "traced"; attribute reads that are static under trace
+(``.shape``, ``.dtype``, ...) break the taint. High precision is the
+contract; a construct the analysis can't prove traced is not flagged, and
+``# tracelint: disable=TLxxx`` suppresses deliberate exceptions per line.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from masters_thesis_tpu.analysis.callgraph import CallGraph, dotted_name
+from masters_thesis_tpu.analysis.findings import (
+    Finding,
+    is_suppressed,
+    suppressed_rules_by_line,
+)
+
+# Attribute reads that are static (host) values even on a tracer.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding"}
+
+HOST_CASTS = {"float", "int", "bool", "complex"}
+HOST_METHODS = {"item", "tolist"}
+
+# jax.random functions that PRODUCE keys (their use is key hygiene, not
+# consumption); everything else under jax.random consumes its key argument.
+KEY_PRODUCERS = {
+    "key", "PRNGKey", "split", "fold_in", "clone", "wrap_key_data",
+    "key_data", "key_impl",
+}
+
+# Builtins whose result is always a host value (len of a tracer is a static
+# int; range over a tracer cannot execute). They break the taint chain.
+HOST_BUILTINS = {
+    "range", "len", "enumerate", "reversed", "zip", "sorted", "isinstance",
+    "hasattr", "getattr", "type", "id", "repr", "str", "format",
+}
+
+# Parameter annotations that mark a host scalar (not a tracer).
+HOST_ANNOTATIONS = {"int", "float", "bool", "str", "bytes", "Path"}
+
+
+def _host_params(fn_node: ast.FunctionDef) -> set[str]:
+    """Parameters provably host-side: annotated as a Python scalar, or
+    bound through a default (the ``def _run(layer=layer)`` closure idiom
+    captures host loop variables; traced positional args don't default)."""
+    host: set[str] = set()
+    args = fn_node.args
+    for a in args.args + args.posonlyargs + args.kwonlyargs:
+        ann = dotted_name(a.annotation) if a.annotation is not None else None
+        if ann is not None and ann.split(".")[-1] in HOST_ANNOTATIONS:
+            host.add(a.arg)
+    positional = args.posonlyargs + args.args
+    for a, default in zip(positional[len(positional) - len(args.defaults):],
+                          args.defaults):
+        del default
+        host.add(a.arg)
+    for a, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            host.add(a.arg)
+    return host
+
+
+def _module_aliases(imports: dict[str, str]) -> tuple[set[str], set[str]]:
+    """(jax-like local names, numpy local names) for one module."""
+    jax_like = {"jax", "jnp", "lax"}
+    numpy_like = set()
+    for local, target in imports.items():
+        root = target.split(".")[0]
+        if root == "jax":
+            jax_like.add(local)
+        elif root == "numpy":
+            numpy_like.add(local)
+    return jax_like, numpy_like
+
+
+def _target_names(target: ast.AST) -> list[str]:
+    """Names actually (re)bound by an assignment target. For subscript /
+    attribute targets only the base is bound — index expressions
+    (``h_out[layer][t] = ...``) are reads, not writes."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return [n for elt in target.elts for n in _target_names(elt)]
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    if isinstance(target, (ast.Subscript, ast.Attribute)):
+        return _target_names(target.value)
+    return []
+
+
+def _walk_expr(expr: ast.AST):
+    """ast.walk over an expression, pruning lambda bodies (their params
+    shadow the enclosing taint environment)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.Lambda):
+                stack.append(child)
+
+
+class _FunctionLinter:
+    """Taint + rule pass over ONE function body (nested defs excluded —
+    they are linted as their own scope with their own trace context)."""
+
+    def __init__(
+        self,
+        fn_node: ast.FunctionDef,
+        params: list[str],
+        traced_context: bool,
+        jax_aliases: set[str],
+        numpy_aliases: set[str],
+        path: str,
+    ):
+        self.fn = fn_node
+        self.traced_context = traced_context
+        self.jax = jax_aliases
+        self.np = numpy_aliases
+        self.path = path
+        self.tainted: set[str] = set(params) - _host_params(fn_node)
+        self.findings: list[Finding] = []
+        # TL103 state, in source order: key name -> production loop stack /
+        # consumption count / first-use line. Parameters count as keys
+        # produced at function entry (loop depth 0), so a key argument
+        # consumed inside a Python loop is caught too.
+        self.key_prod: dict[str, tuple[int, ...]] = {p: () for p in params}
+        self.key_uses: dict[str, tuple[int, int]] = {}
+        self.key_flagged: set[str] = set()
+        self.loop_stack: tuple[int, ...] = ()
+
+    # ------------------------------------------------------------- helpers
+
+    def _is_jax_call(self, call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        return name is not None and name.split(".")[0] in self.jax
+
+    def _is_numpy_call(self, call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        return name is not None and name.split(".")[0] in self.np
+
+    def _traced(self, node: ast.AST) -> bool:
+        """Whether an expression may hold a traced value."""
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self._traced(node.value)
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in HOST_BUILTINS
+            ):
+                return False
+            if self._is_jax_call(node):
+                return True
+            return any(self._traced(a) for a in node.args) or any(
+                self._traced(k.value) for k in node.keywords
+            )
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        return any(self._traced(c) for c in ast.iter_child_nodes(node))
+
+    def _test_traced(self, test: ast.AST) -> bool:
+        """Stricter traced-ness for branch conditions.
+
+        A bare name is NOT enough (it may be a container or host bool, e.g.
+        ``x if sums else y`` over a metric dict); require an actual
+        computation: a jnp/jax call, or a comparison/boolean/arithmetic
+        expression with a traced operand. ``is``/``is not`` compare
+        identity, which is host-safe.
+        """
+        if isinstance(test, ast.Call):
+            return self._is_jax_call(test) or any(
+                self._test_traced(a) for a in test.args
+            )
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return False
+            return self._traced(test)
+        if isinstance(test, (ast.BoolOp, ast.BinOp)):
+            return self._traced(test)
+        if isinstance(test, ast.UnaryOp):
+            return self._test_traced(test.operand)
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(rule=rule, message=message, path=self.path,
+                    line=getattr(node, "lineno", 0))
+        )
+
+    # ---------------------------------------------------------- taint pass
+
+    def _taint_statements(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                if value is not None and self._traced(value):
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for target in targets:
+                        self.tainted.update(_target_names(target))
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if self._traced(stmt.iter):
+                    self.tainted.update(_target_names(stmt.target))
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None and self._traced(
+                        item.context_expr
+                    ):
+                        self.tainted.update(
+                            _target_names(item.optional_vars)
+                        )
+            for attr in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, attr, None)
+                if inner:
+                    self._taint_statements(inner)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._taint_statements(handler.body)
+
+    # ----------------------------------------------------------- rule pass
+
+    def run(self) -> list[Finding]:
+        # Two taint sweeps: the second catches names tainted by statements
+        # later in source order than their first read (loop-carried values).
+        self._taint_statements(self.fn.body)
+        self._taint_statements(self.fn.body)
+        self._visit_block(self.fn.body)
+        return self.findings
+
+    def _visit_block(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        self._reset_keys_on_assign(stmt)
+        if self.traced_context:
+            if isinstance(stmt, (ast.If, ast.While)) and self._test_traced(
+                stmt.test
+            ):
+                self._emit(
+                    "TL102", stmt,
+                    "Python branch on a traced expression inside jitted "
+                    "code (use jnp.where / lax.cond)",
+                )
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) and isinstance(
+                stmt.iter, ast.Call
+            ) and self._is_jax_call(stmt.iter):
+                self._emit(
+                    "TL102", stmt,
+                    "Python loop over a traced array inside jitted code "
+                    "(unrolls at trace time; use lax.scan)",
+                )
+        # Expression-level rules on this statement's own expressions
+        # (headers + simple statements); bodies recurse as statements.
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, ast.expr):
+                continue
+            for node in _walk_expr(child):
+                if (
+                    self.traced_context
+                    and isinstance(node, ast.IfExp)
+                    and self._test_traced(node.test)
+                ):
+                    self._emit(
+                        "TL102", node,
+                        "conditional expression on a traced value inside "
+                        "jitted code (use jnp.where)",
+                    )
+                if isinstance(node, ast.Call):
+                    self._check_call(node)
+        in_loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+        prev = self.loop_stack
+        if in_loop:
+            self.loop_stack = prev + (id(stmt),)
+        for attr in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, attr, None)
+            if inner:
+                self._visit_block(inner)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._visit_block(handler.body)
+        self.loop_stack = prev
+
+    # ------------------------------------------------------------- calls
+
+    def _check_call(self, call: ast.Call) -> None:
+        callee = dotted_name(call.func)
+        # TL104 applies host-side too: an f64 literal anywhere poisons
+        # whatever jitted code consumes the produced array.
+        if (
+            callee is not None
+            and callee.endswith("config.update")
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value == "jax_enable_x64"
+        ):
+            self._emit("TL104", call, "jax_enable_x64 enabled in library code")
+        self._check_key_call(call)
+        if not self.traced_context:
+            return
+        # TL101 — host casts on traced values.
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in HOST_CASTS
+            and len(call.args) == 1
+            and self._traced(call.args[0])
+        ):
+            self._emit(
+                "TL101", call,
+                f"{call.func.id}() on a traced value inside jitted code "
+                "(forces device sync / ConcretizationTypeError)",
+            )
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in HOST_METHODS
+            and self._traced(call.func.value)
+        ):
+            self._emit(
+                "TL101", call,
+                f".{call.func.attr}() on a traced value inside jitted code",
+            )
+        # TL105 — host transfers.
+        if callee in ("jax.device_get", "jax.device_put"):
+            self._emit(
+                "TL105", call,
+                f"{callee} inside jit-reachable code (host<->device "
+                "round-trip in the hot path)",
+            )
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "block_until_ready"
+        ):
+            self._emit(
+                "TL105", call, "block_until_ready inside jit-reachable code"
+            )
+        if self._is_numpy_call(call) and (
+            any(self._traced(a) for a in call.args)
+            or any(self._traced(k.value) for k in call.keywords)
+        ):
+            self._emit(
+                "TL105", call,
+                f"{callee} on a traced value inside jitted code (silent "
+                "host transfer; use jnp)",
+            )
+
+    # ------------------------------------------------------- TL103 (keys)
+
+    def _reset_keys_on_assign(self, stmt: ast.stmt) -> None:
+        """Any rebinding of a name resets its key-consumption count; a
+        producer call additionally records WHERE the fresh key was made
+        (loop depth), for the reuse-across-iterations check."""
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        names = [n for target in targets for n in _target_names(target)]
+        for name in names:
+            self.key_uses.pop(name, None)
+            self.key_prod.pop(name, None)
+            self.key_flagged.discard(name)
+        value = getattr(stmt, "value", None)
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func) or ""
+            parts = callee.split(".")
+            if len(parts) >= 2 and parts[-2] == "random" and (
+                parts[-1] in KEY_PRODUCERS
+            ):
+                for name in names:
+                    self.key_prod[name] = self.loop_stack
+
+    def _check_key_call(self, call: ast.Call) -> None:
+        callee = dotted_name(call.func) or ""
+        parts = callee.split(".")
+        if len(parts) < 2 or parts[-2] != "random":
+            return
+        if parts[-1] in KEY_PRODUCERS:
+            return
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return
+        name = call.args[0].id
+        if name in self.key_flagged:
+            return
+        prod_stack = self.key_prod.get(name)
+        if prod_stack is not None and (
+            len(self.loop_stack) > len(prod_stack)
+            and self.loop_stack[: len(prod_stack)] == prod_stack
+        ):
+            self.key_flagged.add(name)
+            self._emit(
+                "TL103", call,
+                f"PRNG key '{name}' produced outside this loop but "
+                "consumed every iteration (fold_in the loop index)",
+            )
+            return
+        count, first_line = self.key_uses.get(name, (0, call.lineno))
+        count += 1
+        self.key_uses[name] = (count, first_line)
+        if count == 2:
+            self.key_flagged.add(name)
+            self._emit(
+                "TL103", call,
+                f"PRNG key '{name}' consumed again without split/fold_in "
+                f"(first use line {first_line})",
+            )
+
+
+# --------------------------------------------------------------- driver
+
+
+def _module_name(path: Path, root: Path | None) -> str:
+    if root is not None:
+        try:
+            rel = path.resolve().relative_to(root.resolve().parent)
+            return ".".join(rel.with_suffix("").parts)
+        except ValueError:
+            pass
+    return path.stem
+
+
+def discover_files(paths: list[Path]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def _module_level_findings(
+    tree: ast.AST, path: str, linter: _FunctionLinter
+) -> list[Finding]:
+    """TL104 outside any function: calls at module scope, ``jnp.float64``
+    attribute literals, and ``dtype='float64'`` strings anywhere."""
+    findings: list[Finding] = []
+    # Module-scope statements only (function bodies already ran through
+    # their own _FunctionLinter).
+    stack = [
+        n for n in ast.iter_child_nodes(tree)
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            linter._check_call(node)
+        stack.extend(ast.iter_child_nodes(node))
+    findings.extend(linter.findings)
+    # File-wide f64 dtype literals (functions included; unambiguous).
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            findings.append(
+                Finding(
+                    rule="TL104",
+                    message="float64 dtype in library code",
+                    path=path,
+                    line=node.lineno,
+                )
+            )
+        if (
+            isinstance(node, ast.keyword)
+            and node.arg == "dtype"
+            and isinstance(node.value, ast.Constant)
+            and node.value.value in ("float64", "f8", ">f8", "<f8")
+        ):
+            findings.append(
+                Finding(
+                    rule="TL104",
+                    message="dtype='float64' literal",
+                    path=path,
+                    line=node.value.lineno,
+                )
+            )
+    return findings
+
+
+def lint_paths(
+    paths: list[Path | str], package_root: Path | str | None = None
+) -> list[Finding]:
+    """Run the AST lint over files/directories; returns surviving findings.
+
+    ``package_root`` anchors dotted module names (cross-module jit
+    reachability); when omitted, the first directory argument is used.
+    """
+    paths = [Path(p) for p in paths]
+    if package_root is None:
+        package_root = next((p for p in paths if p.is_dir()), None)
+    files = discover_files(paths)
+
+    sources: dict[str, str] = {}
+    trees: dict[str, tuple[Path, ast.AST]] = {}
+    findings: list[Finding] = []
+    for f in files:
+        module = _module_name(f, Path(package_root) if package_root else None)
+        try:
+            src = f.read_text()
+            tree = ast.parse(src, filename=str(f))
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    rule="TL100",
+                    message=f"syntax error: {exc.msg}",
+                    path=str(f),
+                    line=exc.lineno or 0,
+                )
+            )
+            continue
+        sources[module] = src
+        trees[module] = (f, tree)
+
+    graph = CallGraph.build(trees)
+
+    for module, (path, tree) in trees.items():
+        jax_aliases, numpy_aliases = _module_aliases(
+            graph.imports.get(module, {})
+        )
+        suppressions = suppressed_rules_by_line(sources[module])
+        module_findings: list[Finding] = []
+        for info in graph.functions.values():
+            if info.module != module:
+                continue
+            linter = _FunctionLinter(
+                info.node, info.params, graph.is_traced(info.key),
+                jax_aliases, numpy_aliases, str(path),
+            )
+            module_findings.extend(linter.run())
+        top = _FunctionLinter(
+            ast.parse("def _m(): pass").body[0], [], False,
+            jax_aliases, numpy_aliases, str(path),
+        )
+        module_findings.extend(_module_level_findings(tree, str(path), top))
+        findings.extend(
+            f for f in module_findings if not is_suppressed(f, suppressions)
+        )
+
+    seen: set[tuple[str, str, int, str]] = set()
+    unique: list[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique

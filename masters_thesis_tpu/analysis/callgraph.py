@@ -1,0 +1,229 @@
+"""Jit-reachability call graph over a set of Python sources.
+
+The AST rules need to know which functions execute *under a JAX trace*:
+``float(x)`` is perfectly fine in the trainer's host loop and a correctness
+bug inside the scan-epoch program. Runtime introspection can't answer this
+(the lint must run without building the model), so we approximate it
+statically:
+
+1. **Seeds** — a function is trace-context if it is decorated with a JIT
+   wrapper (``jax.jit``, ``partial(jax.jit, ...)``) or its *name* is passed
+   to a wrapper call (``jax.jit(f)``, ``shard_map(f, ...)``,
+   ``lax.scan(f, ...)``, ``jax.vmap(f)``, ``jax.value_and_grad(f)``, ...).
+2. **Propagation** — anything a trace-context function calls (resolvable
+   within the analysed sources, through same-module names or package
+   imports) is trace-context, as are its nested ``def``s.
+
+Name-based resolution is deliberately conservative-toward-marking: two
+functions sharing a name both get marked. False *negatives* (a function
+called only through a variable or a method) are accepted — the lint's
+contract is high precision on what it does flag.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+# Call targets whose function-valued arguments execute under trace.
+JIT_WRAPPERS = {
+    "jit",
+    "pjit",
+    "pmap",
+    "vmap",
+    "grad",
+    "value_and_grad",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "shard_map",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+    "custom_jvp",
+    "pallas_call",
+    "named_call",
+}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_wrapper(callee: str | None) -> bool:
+    return callee is not None and callee.split(".")[-1] in JIT_WRAPPERS
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    key: str  # "<module>:<qualpath>"
+    name: str  # bare def name
+    module: str
+    node: ast.FunctionDef
+    params: list[str]
+    calls: set[str] = dataclasses.field(default_factory=set)
+    children: list[str] = dataclasses.field(default_factory=list)
+    seeded: bool = False
+
+
+class _ModuleCollector(ast.NodeVisitor):
+    def __init__(self, module: str, graph: "CallGraph"):
+        self.module = module
+        self.graph = graph
+        self.stack: list[str] = []
+
+    # ------------------------------------------------------------- imports
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            for alias in node.names:
+                local = alias.asname or alias.name
+                self.graph.imports[self.module][local] = (
+                    f"{node.module}.{alias.name}"
+                )
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name
+            self.graph.imports[self.module][local] = alias.name
+        self.generic_visit(node)
+
+    # ----------------------------------------------------------- functions
+
+    def _handle_def(self, node: ast.FunctionDef) -> None:
+        qual = ".".join(self.stack + [node.name])
+        key = f"{self.module}:{qual}"
+        params = [a.arg for a in node.args.args] + [
+            a.arg for a in node.args.kwonlyargs
+        ]
+        if node.args.vararg:
+            params.append(node.args.vararg.arg)
+        info = FunctionInfo(
+            key=key, name=node.name, module=self.module, node=node,
+            params=params,
+        )
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            callee = dotted_name(target)
+            if _is_jit_wrapper(callee):
+                info.seeded = True
+            # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+            if (
+                isinstance(dec, ast.Call)
+                and callee is not None
+                and callee.split(".")[-1] == "partial"
+                and dec.args
+                and _is_jit_wrapper(dotted_name(dec.args[0]))
+            ):
+                info.seeded = True
+        self.graph.functions[key] = info
+        self.graph.by_name.setdefault((self.module, node.name), []).append(key)
+        if self.stack:
+            parent = f"{self.module}:{'.'.join(self.stack)}"
+            if parent in self.graph.functions:
+                self.graph.functions[parent].children.append(key)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _handle_def
+    visit_AsyncFunctionDef = _handle_def
+
+    # --------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = dotted_name(node.func)
+        if self.stack:
+            current = f"{self.module}:{'.'.join(self.stack)}"
+            if callee is not None:
+                self.graph.functions[current].calls.add(callee)
+        if _is_jit_wrapper(callee):
+            # Every plain-name argument of a jit wrapper call is a seed:
+            # jax.jit(f), shard_map(local_epoch, ...), lax.scan(step, ...).
+            for arg in node.args:
+                name = dotted_name(arg)
+                if name is not None and "." not in name:
+                    self.graph.seed_names.add((self.module, name))
+        self.generic_visit(node)
+
+
+class CallGraph:
+    """Package-wide function index with trace-context propagation."""
+
+    def __init__(self) -> None:
+        self.functions: dict[str, FunctionInfo] = {}
+        self.by_name: dict[tuple[str, str], list[str]] = {}
+        self.imports: dict[str, dict[str, str]] = {}
+        self.seed_names: set[tuple[str, str]] = set()
+        self.modules: dict[str, Path] = {}
+
+    # ------------------------------------------------------------ building
+
+    @classmethod
+    def build(cls, trees: dict[str, tuple[Path, ast.AST]]) -> "CallGraph":
+        """``trees``: module name -> (path, parsed AST)."""
+        graph = cls()
+        for module, (path, tree) in trees.items():
+            graph.modules[module] = path
+            graph.imports.setdefault(module, {})
+            _ModuleCollector(module, graph).visit(tree)
+        graph._propagate()
+        return graph
+
+    # ---------------------------------------------------------- resolution
+
+    def _resolve(self, module: str, callee: str) -> list[str]:
+        """Keys of analysed functions a call name may refer to."""
+        imports = self.imports.get(module, {})
+        head, _, rest = callee.partition(".")
+        if not rest:
+            # Bare name: same-module def, or `from X import name`.
+            hits = list(self.by_name.get((module, callee), []))
+            target = imports.get(callee)
+            if target is not None:
+                t_mod, _, t_name = target.rpartition(".")
+                hits += self.by_name.get((t_mod, t_name), [])
+            return hits
+        # Dotted: `import X as head; head.rest()`.
+        target_mod = imports.get(head)
+        if target_mod is not None:
+            return list(self.by_name.get((target_mod, rest), []))
+        return []
+
+    # --------------------------------------------------------- propagation
+
+    def _propagate(self) -> None:
+        work: list[str] = []
+        for (module, name) in self.seed_names:
+            work.extend(self.by_name.get((module, name), []))
+        work.extend(k for k, f in self.functions.items() if f.seeded)
+        traced: set[str] = set()
+        while work:
+            key = work.pop()
+            if key in traced:
+                continue
+            traced.add(key)
+            info = self.functions[key]
+            info.seeded = True
+            work.extend(info.children)
+            for callee in info.calls:
+                work.extend(self._resolve(info.module, callee))
+        self._traced = traced
+
+    def traced_functions(self) -> set[str]:
+        return set(self._traced)
+
+    def is_traced(self, key: str) -> bool:
+        return key in self._traced

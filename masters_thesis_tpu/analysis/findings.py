@@ -1,0 +1,123 @@
+"""Finding records, rule registry, per-line suppression, and reporting.
+
+Every rule — AST (``TL1xx``) and trace-time (``TA2xx``) — registers here so
+the CLI, the docs, and the suppression parser share one source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+
+# Rule registry: id -> (title, rationale category). Categories mirror the
+# four ways the hot path degrades: retrace, transfer, precision, sharding
+# (plus tracer-safety, which is a correctness hazard before it is a perf
+# one).
+RULES: dict[str, tuple[str, str]] = {
+    "TL101": (
+        "tracer leaked to host cast (float()/int()/bool()/.item()/.tolist() "
+        "on a traced value inside jitted code)",
+        "tracer-safety / transfer",
+    ),
+    "TL102": (
+        "Python control flow on a traced value (if/while/for over a jnp "
+        "expression inside jitted code)",
+        "tracer-safety / recompile",
+    ),
+    "TL103": (
+        "PRNG key consumed more than once without split/fold_in",
+        "correctness (correlated randomness)",
+    ),
+    "TL104": (
+        "float64 literal / x64 enablement (dtype-promotion hazard)",
+        "precision",
+    ),
+    "TL105": (
+        "host transfer inside jit-reachable code (jax.device_get/device_put, "
+        "np.* on traced values, block_until_ready)",
+        "transfer",
+    ),
+    "TA201": (
+        "train step recompiled across steps (compile count > 1)",
+        "recompile",
+    ),
+    "TA202": (
+        "host<->device transfer inside the hot loop (transfer_guard tripped)",
+        "transfer",
+    ),
+    "TA203": (
+        "bad sharding: batch axis not sharded / params not replicated / "
+        "unexpected all-gather in the compiled program",
+        "sharding",
+    ),
+    "TA204": (
+        "output dtype does not match the configured precision policy",
+        "precision",
+    ),
+    "TA205": (
+        "trace-time audit could not run to completion",
+        "infrastructure",
+    ),
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:tracelint:\s*disable|noqa:?)\s*(?:=\s*)?(?P<ids>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    message: str
+    path: str = "<trace>"
+    line: int = 0
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule} {self.message}"
+
+
+def suppressed_rules_by_line(source: str) -> dict[int, set[str] | None]:
+    """Map 1-based line number -> suppressed rule ids (None = all rules).
+
+    Recognises ``# tracelint: disable=TL101`` (per-rule, comma-separable),
+    ``# tracelint: disable`` (whole line), and ``# noqa: TL101`` for
+    composition with standard linting.
+    """
+    out: dict[int, set[str] | None] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        if "#" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = m.group("ids")
+        # A bare "# noqa" (no rule list) from standard linting should not
+        # silently swallow tracelint findings unless it is the tracelint
+        # spelling.
+        if ids is None:
+            if "tracelint" in text:
+                out[lineno] = None
+            continue
+        out[lineno] = {part.strip() for part in ids.split(",")}
+    return out
+
+
+def is_suppressed(
+    finding: Finding, suppressions: dict[int, set[str] | None]
+) -> bool:
+    rules = suppressions.get(finding.line, ())
+    return rules is None or finding.rule in rules
+
+
+def format_report(findings: list[Finding], as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(
+            [dataclasses.asdict(f) for f in findings], indent=2
+        )
+    if not findings:
+        return "tracelint: no findings"
+    lines = [f.format() for f in findings]
+    lines.append(f"tracelint: {len(findings)} finding(s)")
+    return "\n".join(lines)

@@ -17,6 +17,7 @@ from masters_thesis_tpu.parallel.mesh import (
     global_put,
     make_data_mesh,
     replicated_sharding,
+    shard_map,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "global_put",
     "make_data_mesh",
     "replicated_sharding",
+    "shard_map",
 ]

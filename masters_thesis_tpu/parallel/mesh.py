@@ -27,6 +27,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DATA_AXIS = "data"
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-compatible ``shard_map``.
+
+    Newer JAX exposes ``jax.shard_map(..., check_vma=...)``; the pinned
+    0.4.x toolchain only has ``jax.experimental.shard_map.shard_map`` whose
+    equivalent knob is ``check_rep``. All in-repo call sites (and tests) go
+    through this wrapper so the hot path is source-compatible with both.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def distributed_initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -47,8 +68,13 @@ def distributed_initialize(
     """
     # NOT jax.process_count(): that would itself initialize the XLA backend,
     # after which jax.distributed.initialize() refuses to run — the guard
-    # must be side-effect-free.
-    if jax.distributed.is_initialized():
+    # must be side-effect-free (and version-compatible: older JAX has no
+    # jax.distributed.is_initialized).
+    from masters_thesis_tpu.utils.backend_probe import (
+        distributed_client_initialized,
+    )
+
+    if distributed_client_initialized():
         return
     try:
         if coordinator_address is None and num_processes is None:

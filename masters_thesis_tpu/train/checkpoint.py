@@ -26,6 +26,7 @@ from typing import Any
 
 import flax.serialization as fser
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from masters_thesis_tpu.models.objectives import ModelSpec
@@ -183,6 +184,12 @@ def restore_checkpoint(
     sidecar = json.loads(sidecar_path.read_text())
     with ocp.StandardCheckpointer() as ckptr:
         tree = ckptr.restore(path)
+    # Detach every leaf from the checkpointer's restore buffers. Orbax can
+    # hand back arrays aliasing its own (mmap/tensorstore) storage; feeding
+    # such leaves into the donated hot loop lets XLA free memory it does not
+    # own — an observed hard SIGSEGV on the CPU backend (resume + warm
+    # persistent compilation cache). A plain host copy severs the alias.
+    tree = jax.tree_util.tree_map(lambda a: np.array(a), tree)
     spec = ModelSpec(**sidecar["spec"])
     return tree["params"], tree["opt_state"], spec, sidecar["meta"]
 

@@ -35,7 +35,7 @@ from masters_thesis_tpu.models.objectives import (
     mse_window,
     nll_window,
 )
-from masters_thesis_tpu.parallel import DATA_AXIS
+from masters_thesis_tpu.parallel import DATA_AXIS, shard_map
 
 
 def forward_rows(module, params, x, dropout_rng=None):
@@ -147,7 +147,7 @@ def make_train_epoch(
         return params, opt_state, sums
 
     data_spec = Batch(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_epoch,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), data_spec),
@@ -305,7 +305,7 @@ def make_eval_fn(
         P(None, DATA_AXIS),
         P(None, DATA_AXIS),
     )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(), data_spec, P(None, DATA_AXIS)),

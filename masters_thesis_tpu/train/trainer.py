@@ -90,6 +90,7 @@ class Trainer:
         seed: int = 0,
         name: str = "fast",
         resume: bool = False,
+        preflight: bool = False,
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
@@ -114,6 +115,11 @@ class Trainer:
         self.seed = seed
         self.name = name
         self.resume = resume
+        # Run the tracelint trace-time audit (analysis.traceaudit) on this
+        # trainer's mesh before fitting: recompile stability, transfer
+        # guard, sharding, dtype policy. Fails fast with a PreflightError
+        # instead of training slowly/wrongly for hours.
+        self.preflight = preflight
 
     def _resolve_dtype(self, spec, dm):
         """Concrete compute dtype for this (model, window) shape.
@@ -192,6 +198,26 @@ class Trainer:
         checkpoint (reference: train.py:187 passes ckpt_path to fit);
         ``init_state=(params, None)`` warm-starts the weights with a fresh
         optimizer (the thesis' synthetic->real warmup protocol)."""
+        if self.preflight:
+            if self.epoch_mode == "scan":
+                from masters_thesis_tpu.analysis.traceaudit import (
+                    assert_trace_clean,
+                )
+
+                self._print("preflight: trace audit on the fit mesh ...")
+                # Audits the configured model/objective on this trainer's
+                # mesh with tiny synthetic data — raises PreflightError
+                # before any real epoch runs.
+                assert_trace_clean(spec=spec, mesh=self.mesh)
+                self._print("preflight: ok")
+            else:
+                # The stream mode's per-step program has host work (the
+                # prefetcher) inside the loop by design; the scan-epoch
+                # invariants don't apply.
+                self._print(
+                    "preflight: skipped (epoch_mode='stream' streams batches "
+                    "through the host by design)"
+                )
         dm.prepare_data(verbose=self.enable_progress_bar)
         dm.setup("fit")
 

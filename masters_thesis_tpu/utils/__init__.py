@@ -2,6 +2,7 @@
 
 from masters_thesis_tpu.utils.backend_probe import (
     ProbeResult,
+    distributed_client_initialized,
     multihost_rank,
     probe_tpu_backend,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "ProbeResult",
     "atomic_publish",
     "atomic_write_text",
+    "distributed_client_initialized",
     "enable_persistent_compilation_cache",
     "multihost_rank",
     "probe_tpu_backend",

@@ -38,6 +38,36 @@ class ProbeResult:
     detail: str  # "" when ok; reason + child stderr tail otherwise
 
 
+def distributed_client_initialized() -> bool:
+    """Whether ``jax.distributed.initialize`` has run, across JAX versions.
+
+    ``jax.distributed.is_initialized`` only exists in newer JAX releases;
+    older ones (e.g. 0.4.37, the pinned toolchain) expose the same fact via
+    the private distributed client state. Neither path initializes the XLA
+    backend.
+    """
+    import jax
+
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed
+    except ImportError:  # pragma: no cover - future JAX without _src layout
+        return False
+    return getattr(distributed.global_state, "client", None) is not None
+
+
+def _xla_backend_initialized() -> bool:
+    """Whether any XLA backend is already live (so querying it is free)."""
+    try:
+        from jax._src import xla_bridge
+    except ImportError:  # pragma: no cover - future JAX without _src layout
+        return False
+    probe = getattr(xla_bridge, "backends_are_initialized", None)
+    return bool(probe()) if probe is not None else False
+
+
 def multihost_rank() -> tuple[int, int]:
     """(process_index, process_count) WITHOUT initializing the XLA backend.
 
@@ -49,12 +79,14 @@ def multihost_rank() -> tuple[int, int]:
     always go through ``parallel.mesh.distributed_initialize`` (which calls
     ``jax.distributed.initialize``), so an uninitialized distributed client
     proves the run is single-process — answerable with no backend touch.
+    When a backend is ALREADY live the query costs nothing, so ask it
+    directly (this is also what lets tests monkeypatch process_count).
     """
     import jax
 
-    if not jax.distributed.is_initialized():
-        return 0, 1
-    return jax.process_index(), jax.process_count()
+    if distributed_client_initialized() or _xla_backend_initialized():
+        return jax.process_index(), jax.process_count()
+    return 0, 1
 
 
 def probe_tpu_backend(

@@ -25,6 +25,17 @@ def enable_persistent_compilation_cache(cache_dir: Path | None = None) -> bool:
     """Enable JAX's persistent compilation cache; returns False if disabled."""
     if os.environ.get("MT_NO_COMPILE_CACHE"):
         return False
+    if "--xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        # Executables deserialized from the persistent cache on the forced
+        # multi-device host platform diverge numerically from fresh
+        # compiles (observed: the 8-device shard_map train step computes a
+        # 0.7%-different epoch loss on reload than the executable that was
+        # serialized, jaxlib 0.4.x). The env check is deliberate — probing
+        # jax.devices() here would initialize the backend (and can wedge on
+        # a held TPU relay lease).
+        return False
     import jax
 
     cache_dir = Path(cache_dir or DEFAULT_CACHE_DIR)

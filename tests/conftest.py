@@ -29,9 +29,12 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
-# Persistent compilation cache: repeated test runs skip XLA recompiles.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+# NO persistent compilation cache here: executables deserialized from the
+# cache on the forced multi-device host platform diverge numerically from
+# fresh compiles (observed 0.7% on the 8-device shard_map epoch loss,
+# jaxlib 0.4.x — a cached reload is not the same program; see the guard in
+# masters_thesis_tpu/utils/compilation_cache.py). Warm restarts are not
+# worth numerically-unsound tests.
 
 
 @pytest.fixture

@@ -1,0 +1,423 @@
+"""tracelint tests: every AST rule fires on a seeded fixture, the shipped
+package lints clean, suppressions work, and the trace-time audit holds the
+compile-once / no-transfer / sharding invariants on the virtual 8-device
+mesh (conftest.py).
+"""
+
+from pathlib import Path
+
+import jax
+import pytest
+
+from masters_thesis_tpu.analysis import Finding, format_report, lint_paths
+from masters_thesis_tpu.analysis.__main__ import main as cli_main
+from masters_thesis_tpu.analysis.findings import (
+    RULES,
+    is_suppressed,
+    suppressed_rules_by_line,
+)
+from masters_thesis_tpu.analysis.traceaudit import (
+    PreflightError,
+    assert_trace_clean,
+    run_trace_audit,
+)
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1] / "masters_thesis_tpu"
+
+
+def lint_snippet(tmp_path: Path, source: str) -> list[Finding]:
+    f = tmp_path / "snippet.py"
+    f.write_text(source)
+    return lint_paths([f])
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ----------------------------------------------------------------- Pass 1
+
+
+class TestAstRules:
+    def test_tracer_host_cast_in_jit(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+@jax.jit
+def f(x):
+    return float(x) + x.item()
+""",
+        )
+        assert rules_of(findings) == {"TL101"}
+        assert len(findings) == 2
+
+    def test_python_control_flow_on_tracer(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return jnp.log(x)
+    while jnp.any(x < 0):
+        x = x + 1
+    return x
+""",
+        )
+        assert rules_of(findings) == {"TL102"}
+        assert len(findings) == 2
+
+    def test_key_reuse(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+def sample(rng):
+    a = jax.random.normal(rng, (4,))
+    b = jax.random.uniform(rng, (4,))
+    return a + b
+""",
+        )
+        assert rules_of(findings) == {"TL103"}
+
+    def test_key_reuse_across_loop(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+def sample(rng):
+    out = []
+    for i in range(3):
+        out.append(jax.random.normal(rng, (2,)))
+    return out
+""",
+        )
+        assert rules_of(findings) == {"TL103"}
+
+    def test_split_resets_key_state(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+def sample(rng):
+    a_rng, b_rng = jax.random.split(rng)
+    a = jax.random.normal(a_rng, (4,))
+    b = jax.random.uniform(b_rng, (4,))
+    return a + b
+
+def folded(rng, xs):
+    out = []
+    for i in range(3):
+        step = jax.random.fold_in(rng, i)
+        out.append(jax.random.normal(step, (2,)))
+    return out
+""",
+        )
+        assert findings == []
+
+    def test_f64_literal(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax.numpy as jnp
+
+def widen(x):
+    return jnp.asarray(x, dtype="float64") + jnp.zeros(3, jnp.float64)
+""",
+        )
+        assert rules_of(findings) == {"TL104"}
+
+    def test_x64_enablement(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+jax.config.update("jax_enable_x64", True)
+""",
+        )
+        assert rules_of(findings) == {"TL104"}
+
+    def test_host_transfer_in_jit(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    y = np.asarray(x * 2)
+    jax.device_get(x)
+    return y
+""",
+        )
+        assert rules_of(findings) == {"TL105"}
+        assert len(findings) == 2
+
+    def test_host_code_not_flagged(self, tmp_path):
+        # The same constructs OUTSIDE jit-reachable code are the host
+        # loop's job — must not be flagged.
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+import numpy as np
+
+def readback(x):
+    if x is None:
+        return None
+    host = np.asarray(jax.device_get(x))
+    return float(host.sum())
+""",
+        )
+        assert findings == []
+
+    def test_jit_reachability_propagates_through_calls(self, tmp_path):
+        # helper() is not decorated, but is called from inside a jitted
+        # function — rules apply transitively.
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+def helper(x):
+    return float(x)
+
+@jax.jit
+def f(x):
+    return helper(x)
+""",
+        )
+        assert rules_of(findings) == {"TL101"}
+
+    def test_shape_access_breaks_taint(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+@jax.jit
+def f(x):
+    n = x.shape[0]
+    if n > 4:
+        return x[:4]
+    return x
+""",
+        )
+        assert findings == []
+
+    def test_suppression_comment(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+@jax.jit
+def f(x):
+    return float(x)  # tracelint: disable=TL101
+""",
+        )
+        assert findings == []
+
+    def test_bare_noqa_does_not_swallow(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+
+@jax.jit
+def f(x):
+    return float(x)  # noqa
+""",
+        )
+        assert rules_of(findings) == {"TL101"}
+
+    def test_suppression_parser(self):
+        sup = suppressed_rules_by_line(
+            "a = 1  # tracelint: disable=TL101, TL105\n"
+            "b = 2  # tracelint: disable\n"
+            "c = 3  # noqa: TL103\n"
+        )
+        assert sup[1] == {"TL101", "TL105"}
+        assert sup[2] is None
+        assert sup[3] == {"TL103"}
+        assert is_suppressed(Finding("TL101", "m", "f", 1), sup)
+        assert not is_suppressed(Finding("TL102", "m", "f", 1), sup)
+        assert is_suppressed(Finding("TL102", "m", "f", 2), sup)
+
+    def test_every_finding_rule_is_registered(self, tmp_path):
+        findings = lint_snippet(
+            tmp_path,
+            """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def f(x, rng):
+    if x > 0:
+        y = float(x)
+    a = jax.random.normal(rng, (2,))
+    b = jax.random.normal(rng, (2,))
+    np.log(x)
+    return jnp.zeros(2, jnp.float64)
+""",
+        )
+        assert rules_of(findings) <= set(RULES)
+        assert {"TL101", "TL102", "TL103", "TL104", "TL105"} <= rules_of(
+            findings
+        )
+
+    def test_package_tree_is_clean(self):
+        findings = lint_paths([PACKAGE_ROOT], package_root=PACKAGE_ROOT)
+        assert findings == [], format_report(findings)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+class TestCli:
+    def test_clean_tree_exits_zero(self):
+        assert cli_main(["--skip-trace", str(PACKAGE_ROOT)]) == 0
+
+    def test_findings_exit_nonzero(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n"
+        )
+        assert cli_main(["--skip-trace", str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "TL101" in out
+
+    def test_json_output(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n"
+        )
+        assert cli_main(["--skip-trace", "--json", str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert '"rule": "TL101"' in out
+
+
+# ----------------------------------------------------------------- Pass 2
+
+
+class TestTraceAudit:
+    def test_audit_is_clean_on_virtual_mesh(self):
+        findings = run_trace_audit()
+        assert findings == [], format_report(findings)
+
+    def test_train_epoch_compiles_exactly_once_across_steps(self):
+        # The compile-count regression pin: 3 epochs with varying rngs
+        # through the real epoch program must hit ONE cache entry. This is
+        # the audit's TA201 check asserted directly against the jit cache.
+        import jax.numpy as jnp
+        import numpy as np
+
+        from masters_thesis_tpu.analysis import traceaudit as ta
+        from masters_thesis_tpu.models.objectives import ModelSpec
+        from masters_thesis_tpu.parallel import (
+            batch_sharding,
+            global_put,
+            make_data_mesh,
+            replicated_sharding,
+        )
+        from masters_thesis_tpu.train.optim import make_optimizer
+        from masters_thesis_tpu.train.steps import make_train_epoch
+
+        mesh = make_data_mesh(None)
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            kernel_impl="xla",
+        )
+        module = spec.build_module()
+        tx = make_optimizer(None, spec.weight_decay)
+        split = ta._synthetic_split(
+            mesh.size * ta.AUDIT_BATCH * 2, np.random.default_rng(0)
+        )
+        params = module.init(
+            jax.random.key(0),
+            jnp.zeros((1, ta.AUDIT_LOOKBACK, ta.AUDIT_FEATURES)),
+        )["params"]
+        opt_state = tx.init(params)
+        repl = replicated_sharding(mesh)
+        params = global_put(params, repl)
+        opt_state = global_put(opt_state, repl)
+        data = global_put(split, batch_sharding(mesh))
+        epoch_fn = make_train_epoch(
+            module, spec.window_objective(), spec.metric_keys, tx, mesh,
+            batch_size=ta.AUDIT_BATCH,
+        )
+        lr = global_put(jnp.float32(1e-3), repl)
+        for e in range(3):
+            epoch_rng = global_put(
+                jax.random.fold_in(jax.random.key(1), e), repl
+            )
+            params, opt_state, sums = epoch_fn(
+                params, opt_state, lr, epoch_rng, data
+            )
+        jax.block_until_ready(sums)
+        assert epoch_fn._cache_size() == 1
+
+    def test_audit_reports_infrastructure_failure_as_ta205(self):
+        class NotASpec:
+            pass
+
+        findings = run_trace_audit(spec=NotASpec())
+        assert rules_of(findings) == {"TA205"}
+
+    def test_assert_trace_clean_raises_preflight_error(self, monkeypatch):
+        from masters_thesis_tpu.analysis import traceaudit as ta
+
+        monkeypatch.setattr(
+            ta,
+            "run_trace_audit",
+            lambda **kw: [Finding("TA201", "boom")],
+        )
+        with pytest.raises(PreflightError) as exc_info:
+            ta.assert_trace_clean()
+        assert "TA201" in str(exc_info.value)
+        assert exc_info.value.findings[0].rule == "TA201"
+
+    def test_assert_trace_clean_passes(self):
+        assert_trace_clean()
+
+
+# -------------------------------------------------------------- preflight
+
+
+class TestTrainerPreflight:
+    def test_preflight_runs_before_fit(self, monkeypatch, tmp_path):
+        from masters_thesis_tpu.analysis import traceaudit as ta
+        from masters_thesis_tpu.train.trainer import Trainer
+
+        calls = {}
+
+        def fake_audit(**kw):
+            calls["mesh"] = kw.get("mesh")
+            return [Finding("TA203", "seeded failure")]
+
+        monkeypatch.setattr(ta, "run_trace_audit", fake_audit)
+        trainer = Trainer(
+            max_epochs=1,
+            enable_progress_bar=False,
+            enable_model_summary=False,
+            preflight=True,
+        )
+        from masters_thesis_tpu.models.objectives import ModelSpec
+
+        with pytest.raises(PreflightError):
+            trainer.fit(
+                ModelSpec(objective="mse", hidden_size=8, num_layers=1),
+                dm=None,  # preflight raises before the datamodule is touched
+            )
+        assert calls["mesh"] is trainer.mesh

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Repo gate: lint config -> tracelint (both passes) -> tier-1 tests.
+# Usage: tools/check.sh [--fast]   (--fast skips the pytest tier)
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+fail=0
+
+# 1. ruff, when the environment has it (the pinned container does not ship
+#    it; config lives in pyproject.toml so local/CI runs that do have ruff
+#    agree on the rules).
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check masters_thesis_tpu tests || fail=1
+else
+    echo "== ruff == (not installed; skipping)"
+fi
+
+# 2. tracelint: AST lint over the package + trace-time audit on the
+#    hermetic 8-device virtual CPU mesh.
+echo "== tracelint =="
+JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
+
+if [ "${1:-}" = "--fast" ]; then
+    exit $fail
+fi
+
+# 3. Tier-1 tests (the ROADMAP.md quick loop).
+echo "== pytest (tier 1) =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || fail=1
+
+exit $fail
