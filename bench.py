@@ -114,6 +114,7 @@ def _make_trainer(
     measure_epochs: int,
     strategy: str = "single_device",
     n_devices: int | None = None,
+    telemetry=None,
 ):
     from masters_thesis_tpu.train import Trainer
 
@@ -126,15 +127,31 @@ def _make_trainer(
         enable_progress_bar=False,
         enable_model_summary=False,
         seed=0,
+        telemetry=telemetry,
     )
 
 
-def _measure(dm, objective: str, measure_epochs: int) -> float:
+def _point_telemetry(objective: str, batch_size: int):
+    """TelemetryRun for one measured point, or None when not requested.
+
+    ``--telemetry-dir`` travels parent -> watchdog child via
+    MTT_TELEMETRY_DIR (children only inherit the environment), so every
+    point's events.jsonl lands under one root the operator named.
+    """
+    root = os.environ.get("MTT_TELEMETRY_DIR")
+    if not root:
+        return None
+    from masters_thesis_tpu.telemetry import TelemetryRun
+
+    return TelemetryRun(Path(root) / f"point_{objective}_bs{batch_size}")
+
+
+def _measure(dm, objective: str, measure_epochs: int, telemetry=None) -> float:
     """steps/sec for one (datamodule, objective) point; compile excluded."""
     from masters_thesis_tpu.models.objectives import ModelSpec
 
     spec = ModelSpec(objective=objective)  # model=small defaults
-    result = _make_trainer(measure_epochs).fit(spec, dm)
+    result = _make_trainer(measure_epochs, telemetry=telemetry).fit(spec, dm)
     return result.steps_per_sec
 
 
@@ -300,13 +317,17 @@ def _point_child(objective: str, batch_size: int, epochs: int) -> None:
     )
     dm.prepare_data(verbose=False)
     dm.setup()
-    sps = _measure(dm, objective, epochs)
+    tel = _point_telemetry(objective, batch_size)
+    sps = _measure(dm, objective, epochs, telemetry=tel)
+    if tel is not None:
+        tel.close()
     import jax
 
     print(json.dumps({
         "steps_per_sec": sps,
         "platform": jax.devices()[0].platform,
         "windows_per_epoch": len(dm.train_range),
+        "telemetry": None if tel is None else str(tel.run_dir),
     }))
 
 
@@ -357,6 +378,16 @@ def _measure_point(
 
 
 def main() -> None:
+    if "--telemetry-dir" in sys.argv:
+        # Export before the first watchdog child spawns: points write their
+        # event streams under <dir>/point_<objective>_bs<bs>, and the
+        # parent records the bench envelope under <dir>/bench.
+        i = sys.argv.index("--telemetry-dir")
+        try:
+            os.environ["MTT_TELEMETRY_DIR"] = str(Path(sys.argv[i + 1]))
+        except IndexError:
+            print("--telemetry-dir needs a path argument", file=sys.stderr)
+            sys.exit(2)
     if "--preflight" in sys.argv:
         # Gate the benchmark on the tracelint trace-time audit: a recompile
         # / transfer / sharding regression makes every number below
@@ -379,6 +410,14 @@ def main() -> None:
     bootstrap_synthetic(data_dir, n_stocks=N_STOCKS, n_samples=N_SAMPLES, seed=0)
 
     t0 = time.perf_counter()
+    bench_tel = None
+    if os.environ.get("MTT_TELEMETRY_DIR"):
+        from masters_thesis_tpu.telemetry import TelemetryRun
+
+        bench_tel = TelemetryRun(Path(os.environ["MTT_TELEMETRY_DIR"]) / "bench")
+        bench_tel.event(
+            "bench_started", degraded=degraded, probe_attempts=probe_attempts
+        )
     headline = None
     if not degraded:
         # Healthy probe: all device-touching measurements run behind
@@ -495,6 +534,9 @@ def main() -> None:
         )
         if carried is not None:
             result["detail"]["last_known_tpu"] = carried
+    if bench_tel is not None:
+        bench_tel.event("bench_finished", degraded=degraded, result=result)
+        bench_tel.close()
     print(json.dumps(result))
 
 

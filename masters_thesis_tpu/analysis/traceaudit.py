@@ -211,7 +211,9 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
             )
         )
 
-    cache_size = getattr(epoch_fn, "_cache_size", lambda: None)()
+    from masters_thesis_tpu.train.steps import jit_cache_size
+
+    cache_size = jit_cache_size(epoch_fn)
     if cache_size is not None and cache_size != 1:
         findings.append(
             Finding(

@@ -5,11 +5,20 @@ pinned-memory copies (reference: src/data.py:236-244): batches are pushed to
 device asynchronously ``size`` steps ahead of consumption, so the host→HBM
 transfer of batch *k+1* overlaps the device compute of batch *k* (JAX
 dispatch is async; ``device_put`` returns immediately).
+
+Instrumentation: pass a :class:`PrefetchStats` to make input-pipeline
+starvation observable rather than inferred. Because this generator is
+synchronous, the host time spent inside ``next(source)`` + dispatch is
+exactly the time that could NOT overlap device compute — the stream-mode
+trainer reads per-epoch deltas off the stats object and telemetry reports
+it as the run's data-wait / starvation figure.
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
+import time
 from typing import Iterable, Iterator, Any
 
 import jax
@@ -17,8 +26,44 @@ import jax
 from masters_thesis_tpu.parallel import global_put
 
 
+@dataclasses.dataclass
+class PrefetchStats:
+    """Counters a prefetch iterator updates in place (host-side only)."""
+
+    gets: int = 0            # items pulled from the source iterator
+    yields: int = 0          # items handed to the consumer
+    get_wait_s: float = 0.0  # host time producing + dispatching items
+    depth_sum: int = 0       # queue depth observed at each yield
+    min_depth: int | None = None
+    exhausted: bool = False  # source ran dry (the tail of every epoch)
+
+    def observe_depth(self, depth: int) -> None:
+        self.yields += 1
+        self.depth_sum += depth
+        self.min_depth = (
+            depth if self.min_depth is None else min(self.min_depth, depth)
+        )
+
+    @property
+    def mean_depth(self) -> float:
+        return self.depth_sum / self.yields if self.yields else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "gets": self.gets,
+            "yields": self.yields,
+            "get_wait_s": self.get_wait_s,
+            "mean_depth": self.mean_depth,
+            "min_depth": self.min_depth,
+            "exhausted": self.exhausted,
+        }
+
+
 def prefetch_to_device(
-    iterator: Iterable[Any], size: int = 2, sharding=None
+    iterator: Iterable[Any],
+    size: int = 2,
+    sharding=None,
+    stats: PrefetchStats | None = None,
 ) -> Iterator[Any]:
     """Yield items from ``iterator`` with ``size`` items already on device.
 
@@ -28,6 +73,9 @@ def prefetch_to_device(
         sharding: optional ``jax.sharding.Sharding`` to place each leaf with
             (used by the data-parallel trainer to shard the batch axis);
             default places on the default device.
+        stats: optional :class:`PrefetchStats` updated in place — get-wait
+            seconds, queue depth per yield, and exhaustion, so telemetry
+            can report starvation instead of guessing at it.
     """
     if size < 0:
         raise ValueError(f"prefetch size must be >= 0, got {size}")
@@ -44,19 +92,36 @@ def prefetch_to_device(
         return jax.device_put(item)
 
     it = iter(iterator)
+
+    def pull() -> bool:
+        """Produce + dispatch one item; False once the source is dry."""
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            if stats is not None:
+                stats.get_wait_s += time.perf_counter() - t0
+                stats.exhausted = True
+            return False
+        queue.append(put(item))
+        if stats is not None:
+            stats.get_wait_s += time.perf_counter() - t0
+            stats.gets += 1
+        return True
+
     if size == 0:  # no lookahead: plain put-then-yield
-        for item in it:
-            yield put(item)
+        while pull():
+            if stats is not None:
+                stats.observe_depth(len(queue))
+            yield queue.popleft()
         return
-    try:
-        for _ in range(size):
-            queue.append(put(next(it)))
-    except StopIteration:
-        pass
+
+    for _ in range(size):
+        if not pull():
+            break
 
     while queue:
+        if stats is not None:
+            stats.observe_depth(len(queue))
         yield queue.popleft()
-        try:
-            queue.append(put(next(it)))
-        except StopIteration:
-            pass
+        pull()

@@ -1,0 +1,51 @@
+"""Runtime telemetry: structured step-level metrics and run reports.
+
+The static/trace-time contract layer (``analysis``, tracelint) proves what
+a program SHOULD do; this package measures what runs actually DO:
+
+- :mod:`registry` — in-process counters/gauges/histograms with host and
+  process tagging (stdlib-only, importable anywhere);
+- :mod:`events`   — the per-run structured JSONL event sink, flushed per
+  line so killed runs still report;
+- :mod:`run`      — :class:`TelemetryRun` (one run's sink + registry),
+  :class:`CompileTracker` (TA201 as a runtime counter via jit cache-miss
+  deltas), :class:`EpochRecorder` (async-dispatch-aware epoch accounting
+  that fences only at boundaries the trainer takes anyway), and device
+  memory / live-buffer sampling;
+- :mod:`profiling` — programmatic ``jax.profiler`` capture windows
+  (``profile_steps=(N, M)``) under the run dir;
+- :mod:`report` + ``__main__`` — ``python -m masters_thesis_tpu.telemetry
+  summarize <run>``: steps/sec, p50/p99 step time, recompiles, time split,
+  starvation, peak memory; exits nonzero on contract violations.
+
+Event schema and metric taxonomy: docs/telemetry.md.
+"""
+
+from masters_thesis_tpu.telemetry.events import EventSink, read_events
+from masters_thesis_tpu.telemetry.profiling import ProfilerWindow
+from masters_thesis_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from masters_thesis_tpu.telemetry.run import (
+    CompileTracker,
+    EpochRecorder,
+    TelemetryRun,
+    device_memory_snapshot,
+)
+
+__all__ = [
+    "CompileTracker",
+    "Counter",
+    "EpochRecorder",
+    "EventSink",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ProfilerWindow",
+    "TelemetryRun",
+    "device_memory_snapshot",
+    "read_events",
+]

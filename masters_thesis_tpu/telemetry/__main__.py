@@ -1,0 +1,102 @@
+"""``python -m masters_thesis_tpu.telemetry`` — run reports from JSONL.
+
+Subcommands:
+
+- ``summarize <run>`` — render the run report for a run directory (or an
+  ``events.jsonl`` file directly). Exit codes: 0 = ok, 1 = could not load,
+  2 = the report shows contract violations (recompiles > 1, failed
+  preflight, divergence) — so CI and the grid runner can gate on it.
+- ``selfcheck`` — hermetic smoke of the whole pipeline (registry ->
+  events -> report) in a temp dir; the tools/check.sh telemetry gate.
+
+Deliberately jax-free: summarize runs on operator machines where touching
+the backend can hang on a wedged relay lease (docs/OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+
+
+def _summarize(args) -> int:
+    from masters_thesis_tpu.telemetry.report import (
+        render_json,
+        render_text,
+        summarize_path,
+    )
+
+    try:
+        report = summarize_path(args.run)
+    except FileNotFoundError as exc:
+        print(f"summarize: {exc}", file=sys.stderr)
+        return 1
+    print(render_json(report) if args.json else render_text(report))
+    return 2 if report["violations"] else 0
+
+
+def _selfcheck(args) -> int:
+    from masters_thesis_tpu.telemetry.report import summarize_path
+    from masters_thesis_tpu.telemetry.run import TelemetryRun
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tel = TelemetryRun(tmp, run_id="selfcheck")
+        tel.event(
+            "run_started", platform="cpu", n_devices=1, strategy="selfcheck",
+            epoch_mode="scan", steps_per_epoch=4,
+        )
+        for epoch in range(3):
+            tel.event(
+                "epoch", epoch=epoch, steps=4, wall_s=0.4 if epoch else 2.0,
+                dispatch_s=0.01, device_s=0.38 if epoch else None,
+                data_wait_s=0.0, compile_events=0 if epoch else 1,
+                compiled=not epoch, fenced=True, steps_per_sec=10.0,
+            )
+            tel.histogram("train/epoch_wall_s").observe(0.4)
+        tel.event(
+            "run_finished", epochs=3, total_steps=12, steps_per_sec=10.0,
+            diverged=False, best_val=0.5, epoch_compiles=1, eval_compiles=1,
+        )
+        tel.snapshot_metrics()
+        tel.close()
+        report = summarize_path(tmp)
+    ok = (
+        report["compiles"]["train_epoch"] == 1
+        and report["steps_per_sec"] == 10.0
+        and report["step_time_ms"]["p50"] is not None
+        and not report["violations"]
+    )
+    print("telemetry: selfcheck " + ("ok" if ok else f"FAILED: {report}"))
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m masters_thesis_tpu.telemetry",
+        description="run reports over structured step-level telemetry",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_sum = sub.add_parser(
+        "summarize", help="render a run report from a run dir's events.jsonl"
+    )
+    p_sum.add_argument(
+        "run", help="run directory (or events.jsonl file) to summarize"
+    )
+    p_sum.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p_sum.set_defaults(fn=_summarize)
+    p_check = sub.add_parser(
+        "selfcheck", help="hermetic registry->events->report smoke"
+    )
+    p_check.set_defaults(fn=_selfcheck)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # summarize | head/less closed the pipe
+        sys.exit(0)

@@ -1,0 +1,94 @@
+"""Structured JSONL event sink — one append-only stream per run.
+
+Each event is one JSON object per line with a fixed envelope
+(``ts``/``kind``/``run``/``seq``/``host``/``pid``/``proc``) and a flat,
+kind-specific payload (schema: docs/telemetry.md). The file is flushed
+after every line: a SIGKILL mid-run (the grid runner's budget cap, a relay
+wedge watchdog) loses at most the event being written, and a resumed run
+appends to the same stream rather than clobbering it.
+
+Stdlib-only — the summarize CLI reads these files on machines where
+importing a backend is unsafe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+
+# Envelope keys; payload keys must not collide (enforced at emit time).
+RESERVED_KEYS = ("ts", "kind", "run", "seq", "host", "pid", "proc")
+
+
+class EventSink:
+    """Thread-safe append-only JSONL writer with per-line flush."""
+
+    def __init__(self, path: str | Path, run_id: str, proc: int | None = None):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.run_id = run_id
+        self.proc = proc
+        self._host = socket.gethostname()
+        self._pid = os.getpid()
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._file = None
+
+    def emit(self, kind: str, **payload) -> dict:
+        clashes = [k for k in payload if k in RESERVED_KEYS]
+        if clashes:
+            raise ValueError(f"payload keys clash with envelope: {clashes}")
+        with self._lock:
+            event = {
+                "ts": time.time(),
+                "kind": kind,
+                "run": self.run_id,
+                "seq": self._seq,
+                "host": self._host,
+                "pid": self._pid,
+                "proc": self.proc,
+                **payload,
+            }
+            self._seq += 1
+            if self._file is None:
+                self._file = open(self.path, "a", encoding="utf-8")
+            self._file.write(json.dumps(event, default=_jsonable) + "\n")
+            self._file.flush()
+            return event
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def _jsonable(obj):
+    """Last-resort coercion: numpy scalars, Paths, anything with float()."""
+    if isinstance(obj, Path):
+        return str(obj)
+    for cast in (float, int):
+        try:
+            return cast(obj)
+        except (TypeError, ValueError):
+            continue
+    return str(obj)
+
+
+def read_events(path: str | Path) -> list[dict]:
+    """Load a JSONL event stream; tolerates a torn final line (SIGKILL)."""
+    events: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail from a killed writer
+    return events

@@ -1,0 +1,79 @@
+"""Programmatic ``jax.profiler`` capture windows.
+
+``ProfilerWindow((N, M), trace_dir)`` captures epochs N..M (inclusive)
+into ``trace_dir`` — the trainer starts the trace before dispatching
+epoch N and stops it after epoch M behind a ``block_until_ready`` fence
+(a sampling boundary: the fence is what makes the trace end at a clean
+program boundary, and it is the ONLY fence profiling adds). A window of
+``None`` is a no-op object so the trainer's loop carries no conditionals.
+
+jax is imported lazily at start time: constructing a window (e.g. from
+config parsing) must not initialize a backend.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable
+
+
+class ProfilerWindow:
+    def __init__(
+        self,
+        window: tuple[int, int] | None,
+        trace_dir: str | Path,
+        telemetry=None,
+    ):
+        if window is not None:
+            start, end = int(window[0]), int(window[1])
+            if start < 0 or end < start:
+                raise ValueError(
+                    f"profile window must be 0 <= start <= end, got {window!r}"
+                )
+            window = (start, end)
+        self.window = window
+        self.trace_dir = Path(trace_dir)
+        self.telemetry = telemetry
+        self.active = False
+
+    def wants_fence(self, epoch: int) -> bool:
+        """True for epochs inside the window: the trainer fences these so
+        the captured trace aligns with epoch boundaries."""
+        return (
+            self.window is not None
+            and self.window[0] <= epoch <= self.window[1]
+        )
+
+    def maybe_start(self, epoch: int) -> None:
+        if self.window is None or self.active or epoch != self.window[0]:
+            return
+        import jax
+
+        self.trace_dir.mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(str(self.trace_dir))
+        self.active = True
+
+    def maybe_stop(self, epoch: int, fence: Callable[[], None]) -> None:
+        if not self.active or epoch < self.window[1]:
+            return
+        self._stop(fence)
+
+    def close(self, fence: Callable[[], None]) -> None:
+        """Close a still-open trace (divergence break mid-window) so the
+        diagnostic data is written out rather than lost."""
+        if self.active:
+            self._stop(fence)
+
+    def _stop(self, fence: Callable[[], None]) -> None:
+        import jax
+
+        fence()
+        jax.profiler.stop_trace()
+        self.active = False
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "profile_window",
+                start_epoch=self.window[0],
+                end_epoch=self.window[1],
+                trace_dir=str(self.trace_dir),
+            )

@@ -1,0 +1,153 @@
+"""In-process metrics registry: counters, gauges, histograms.
+
+The registry is the run's live aggregate state; the JSONL event stream
+(:mod:`events`) is its durable form. Both are host-side and stdlib-only —
+nothing here may import jax, because the ``summarize`` CLI loads this
+package on machines where touching the backend can hang forever (the
+wedged-relay failure mode, docs/OPERATIONS.md).
+
+Instruments are tagged with host/process identity so multi-host runs can
+merge event streams without ambiguity. Histograms keep a bounded,
+deterministic sample (no RNG — stride-decimation, not reservoir sampling)
+plus exact count/sum/min/max; report-grade quantiles come from the event
+stream, the in-registry quantiles are a cheap live approximation.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+
+def default_tags() -> dict:
+    """Host/process identity tags stamped on every instrument snapshot.
+
+    ``process_index`` is filled by :class:`~.run.TelemetryRun` once a
+    backend exists; this module never imports jax to find out.
+    """
+    return {"host": socket.gethostname(), "pid": os.getpid()}
+
+
+class Counter:
+    """Monotonic accumulator (float increments allowed: seconds, bytes)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: float = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative increment")
+        self.value += amount
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: float | None = None
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Summary stats + a bounded deterministic sample of observations.
+
+    Once ``max_samples`` is reached the sample is decimated by dropping
+    every other kept value and the keep-stride doubles — bounded memory,
+    no randomness, and the kept points stay spread over the whole run
+    rather than clustered at the start.
+    """
+
+    def __init__(self, name: str, max_samples: int = 2048):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._samples: list[float] = []
+        self._max_samples = max_samples
+        self._stride = 1
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        if (self.count - 1) % self._stride == 0:
+            self._samples.append(value)
+            if len(self._samples) >= self._max_samples:
+                self._samples = self._samples[::2]
+                self._stride *= 2
+
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile over the kept sample (live approximation)."""
+        if not self._samples:
+            return None
+        ordered = sorted(self._samples)
+        idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+        return ordered[idx]
+
+    def snapshot(self) -> dict:
+        return {
+            "type": "histogram",
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.sum / self.count) if self.count else None,
+            "p50": self.quantile(0.50),
+            "p99": self.quantile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Named instruments, created on first use, snapshot-able as one dict."""
+
+    def __init__(self, tags: dict | None = None):
+        self.tags = dict(default_tags())
+        if tags:
+            self.tags.update(tags)
+        self._lock = threading.Lock()
+        self._instruments: dict[str, Counter | Gauge | Histogram] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, requested {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tags": dict(self.tags),
+                "metrics": {
+                    name: inst.snapshot()
+                    for name, inst in sorted(self._instruments.items())
+                },
+            }

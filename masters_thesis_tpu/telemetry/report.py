@@ -1,0 +1,263 @@
+"""Run reports: fold a JSONL event stream into the numbers that matter.
+
+Stdlib-only by contract — this module is what ``python -m
+masters_thesis_tpu.telemetry summarize`` runs on operator machines, where
+importing jax can acquire (and hang on) the TPU relay lease. Everything
+here is arithmetic over dicts.
+
+The report answers the ROADMAP's standing perf questions from one file:
+
+- throughput: steps/sec over post-compile epochs, p50/p99 step time;
+- the TA201 contract at runtime: how many times did the epoch program
+  actually compile (exactly 1 is the contract; >1 is a violation that
+  makes the CLI exit nonzero);
+- where the wall time went: compile / device / host dispatch / data wait;
+- input pipeline health: starvation fraction (stream mode);
+- peak device memory and live buffers;
+- the run's preflight verdict, recorded as an event by the Trainer.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from masters_thesis_tpu.telemetry.events import read_events
+
+EVENTS_FILENAME = "events.jsonl"
+
+
+def resolve_events_path(target: str | Path) -> Path:
+    """Accept a run dir, a dir containing one run, or an events file."""
+    target = Path(target)
+    if target.is_file():
+        return target
+    direct = target / EVENTS_FILENAME
+    if direct.is_file():
+        return direct
+    nested = sorted(target.glob(f"*/{EVENTS_FILENAME}"))
+    if len(nested) == 1:
+        return nested[0]
+    if len(nested) > 1:
+        raise FileNotFoundError(
+            f"{target} holds {len(nested)} event streams; pass one of: "
+            + ", ".join(str(p.parent) for p in nested)
+        )
+    raise FileNotFoundError(f"no {EVENTS_FILENAME} under {target}")
+
+
+def _quantile(sorted_values: list[float], q: float) -> float | None:
+    if not sorted_values:
+        return None
+    idx = min(len(sorted_values) - 1, max(0, round(q * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Fold an event stream into the run report dict (see render_text)."""
+    by_kind: dict[str, list[dict]] = {}
+    for ev in events:
+        by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+
+    started = (by_kind.get("run_started") or [{}])[-1]
+    finished = (by_kind.get("run_finished") or [{}])[-1]
+    epochs = by_kind.get("epoch", [])
+    steady = [e for e in epochs if not e.get("compiled")]
+    compile_epochs = [e for e in epochs if e.get("compiled")]
+
+    # Throughput: prefer the trainer's own post-compile figure (measured
+    # fence-to-fence over the whole run); fall back to summing epoch events.
+    steps_per_sec = finished.get("steps_per_sec")
+    steady_steps = sum(e.get("steps") or 0 for e in steady)
+    steady_wall = sum(e.get("wall_s") or 0.0 for e in steady)
+    if steps_per_sec is None and steady_wall > 0:
+        steps_per_sec = steady_steps / steady_wall
+
+    step_times = sorted(
+        (e["wall_s"] / e["steps"])
+        for e in steady
+        if e.get("wall_s") and e.get("steps")
+    )
+
+    # Compile accounting: epoch events carry per-epoch cache-miss deltas;
+    # run_finished carries the totals (authoritative when present).
+    epoch_compiles = finished.get("epoch_compiles")
+    if epoch_compiles is None:
+        epoch_compiles = sum(e.get("compile_events") or 0 for e in epochs)
+    eval_compiles = finished.get("eval_compiles")
+    if eval_compiles is None:
+        eval_compiles = sum(
+            e.get("compile_events") or 0 for e in by_kind.get("eval", [])
+        )
+    first_compile_s = (
+        compile_epochs[0].get("wall_s") if compile_epochs else None
+    )
+
+    compile_s = sum(e.get("wall_s") or 0.0 for e in compile_epochs)
+    device_s = sum(
+        e["device_s"] for e in steady if e.get("device_s") is not None
+    )
+    dispatch_s = sum(
+        e["dispatch_s"] for e in steady if e.get("dispatch_s") is not None
+    )
+    data_wait_s = sum(e.get("data_wait_s") or 0.0 for e in epochs)
+    total_wall = compile_s + steady_wall
+
+    # Starvation: fraction of steady-state wall the host spent PRODUCING
+    # the next batch instead of overlapping device compute. Scan-mode runs
+    # (device-resident split) are structurally 0.
+    steady_data_wait = sum(e.get("data_wait_s") or 0.0 for e in steady)
+    starvation_pct = (
+        100.0 * steady_data_wait / steady_wall if steady_wall > 0 else 0.0
+    )
+
+    mem_events = by_kind.get("memory", [])
+    peak_bytes = _max_of(mem_events, "peak_bytes_in_use")
+    bytes_in_use = _max_of(mem_events, "bytes_in_use")
+    live_bytes = _max_of(mem_events, "live_buffer_bytes")
+    peak = next(
+        (v for v in (peak_bytes, bytes_in_use, live_bytes) if v is not None),
+        None,
+    )
+
+    preflight = (by_kind.get("preflight") or [{}])[-1]
+    profile_windows = [
+        {k: e.get(k) for k in ("start_epoch", "end_epoch", "trace_dir")}
+        for e in by_kind.get("profile_window", [])
+    ]
+
+    report = {
+        "run": started.get("run") or (events[0].get("run") if events else None),
+        "platform": started.get("platform"),
+        "n_devices": started.get("n_devices"),
+        "strategy": started.get("strategy"),
+        "epoch_mode": started.get("epoch_mode"),
+        "epochs": len(epochs),
+        "total_steps": sum(e.get("steps") or 0 for e in epochs),
+        "steps_per_sec": steps_per_sec,
+        "step_time_ms": {
+            "p50": _scale(_quantile(step_times, 0.50), 1e3),
+            "p99": _scale(_quantile(step_times, 0.99), 1e3),
+            "mean": _scale(
+                (sum(step_times) / len(step_times)) if step_times else None,
+                1e3,
+            ),
+            "samples": len(step_times),
+        },
+        "compiles": {
+            "train_epoch": epoch_compiles,
+            "eval": eval_compiles,
+            "first_compile_s": first_compile_s,
+        },
+        "time_split_s": {
+            "total": total_wall,
+            "compile": compile_s,
+            "device": device_s,
+            "dispatch": dispatch_s,
+            "data_wait": data_wait_s,
+        },
+        "data": {
+            "data_wait_s": data_wait_s,
+            "starvation_pct": starvation_pct,
+        },
+        "memory": {
+            "peak_bytes": peak,
+            "peak_bytes_in_use": peak_bytes,
+            "live_buffer_bytes": live_bytes,
+            "source": mem_events[-1].get("source") if mem_events else None,
+        },
+        "preflight": preflight.get("status"),
+        "diverged": finished.get("diverged"),
+        "profile_windows": profile_windows,
+        "best_val": finished.get("best_val"),
+    }
+    report["violations"] = contract_violations(report)
+    return report
+
+
+def contract_violations(report: dict) -> list[str]:
+    """The runtime contracts a run report is gated on (CLI exits 2)."""
+    violations = []
+    compiles = report["compiles"]["train_epoch"] or 0
+    if compiles > 1:
+        violations.append(
+            f"recompile: the train epoch program compiled {compiles} times "
+            "across the run (contract: exactly once — TA201 at runtime)"
+        )
+    if report.get("preflight") == "failed":
+        violations.append("preflight: the tracelint trace audit failed")
+    if report.get("diverged"):
+        violations.append("divergence: the run halted on a non-finite loss")
+    return violations
+
+
+def summarize_path(target: str | Path) -> dict:
+    return summarize_events(read_events(resolve_events_path(target)))
+
+
+def _max_of(events: list[dict], key: str) -> float | None:
+    values = [e[key] for e in events if e.get(key) is not None]
+    return max(values) if values else None
+
+
+def _scale(value, factor):
+    return None if value is None else value * factor
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _fmt(value, spec: str = ".3g") -> str:
+    return "n/a" if value is None else format(value, spec)
+
+
+def render_text(report: dict) -> str:
+    """Human-readable run report (the CLI's default output)."""
+    t = report["time_split_s"]
+    mem = report["memory"]
+    st = report["step_time_ms"]
+    lines = [
+        f"run            : {report.get('run') or 'n/a'}",
+        f"platform       : {report.get('platform') or 'n/a'} "
+        f"x{report.get('n_devices') or '?'} "
+        f"({report.get('strategy') or '?'}, {report.get('epoch_mode') or '?'})",
+        f"epochs / steps : {report['epochs']} / {report['total_steps']}",
+        f"steps/sec      : {_fmt(report['steps_per_sec'], '.2f')}",
+        f"step time (ms) : p50 {_fmt(st['p50'], '.3f')} | "
+        f"p99 {_fmt(st['p99'], '.3f')} | mean {_fmt(st['mean'], '.3f')} "
+        f"({st['samples']} samples)",
+        f"compiles       : train_epoch={report['compiles']['train_epoch']} "
+        f"eval={report['compiles']['eval']} "
+        f"(first compile {_fmt(report['compiles']['first_compile_s'], '.2f')}s)",
+        f"time split (s) : compile {t['compile']:.2f} | device {t['device']:.2f}"
+        f" | dispatch {t['dispatch']:.2f} | data-wait {t['data_wait']:.2f}"
+        f" | total {t['total']:.2f}",
+        f"input pipeline : data-wait {report['data']['data_wait_s']:.3f}s, "
+        f"starvation {report['data']['starvation_pct']:.1f}%",
+        f"device memory  : peak {_fmt_bytes(mem['peak_bytes'])} "
+        f"(live buffers {_fmt_bytes(mem['live_buffer_bytes'])}, "
+        f"source: {mem['source'] or 'n/a'})",
+        f"preflight      : {report.get('preflight') or 'not recorded'}",
+    ]
+    for w in report.get("profile_windows", []):
+        lines.append(
+            f"profiler trace : epochs {w['start_epoch']}..{w['end_epoch']} "
+            f"-> {w['trace_dir']}"
+        )
+    if report["violations"]:
+        lines.append("CONTRACT VIOLATIONS:")
+        lines.extend(f"  - {v}" for v in report["violations"])
+    else:
+        lines.append("contracts      : ok")
+    return "\n".join(lines)
+
+
+def render_json(report: dict) -> str:
+    return json.dumps(report, indent=2, default=str)

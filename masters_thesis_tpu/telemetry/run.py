@@ -1,0 +1,270 @@
+"""Per-run telemetry: run directory, event stream, registry, and the
+async-dispatch-aware epoch accounting the Trainer drives.
+
+The central constraint is the framework's own performance contract: the
+steady-state hot loop must not gain host fences (tracelint TA202/TL105).
+So :class:`EpochRecorder` measures unfenced epochs boundary-to-boundary —
+epoch N's wall closes when epoch N+1 is dispatched, which in the pipelined
+trainer equals N's device time once the loop self-paces on the deferred
+metric readback — and only epochs the trainer fences ANYWAY (val epochs,
+the first epoch, profiler-window epochs) carry an exact ``device_s`` from
+the fence itself. Compile events are not inferred from timing: they are
+counted from jit cache-miss deltas (``train.steps.jit_cache_size``), which
+turns tracelint's TA201 "compiles exactly once" from a preflight assertion
+into a measured runtime counter.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+from typing import Callable
+
+from masters_thesis_tpu.telemetry.events import EventSink
+from masters_thesis_tpu.telemetry.registry import MetricsRegistry
+
+
+def _process_index() -> int | None:
+    """jax.process_index() iff jax is already imported AND initialized-safe.
+
+    Never imports jax: telemetry must stay usable (and hang-free) in
+    host-only tooling.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return int(jax.process_index())
+    except Exception:  # backend not up yet — identity is optional
+        return None
+
+
+class TelemetryRun:
+    """One run's telemetry: ``<run_dir>/events.jsonl`` + a live registry.
+
+    Append-semantics: constructing a TelemetryRun over an existing run dir
+    continues its event stream (the resumed-training case) — consumers
+    group by the ``run`` envelope field when they care about attempts.
+    """
+
+    def __init__(
+        self,
+        run_dir: str | Path,
+        run_id: str | None = None,
+        meta: dict | None = None,
+    ):
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        proc = _process_index()
+        if run_id is None:
+            run_id = time.strftime("%Y%m%d-%H%M%S") + f"-p{proc or 0}"
+        self.run_id = run_id
+        self.registry = MetricsRegistry(
+            tags={} if proc is None else {"process_index": proc}
+        )
+        self.sink = EventSink(
+            self.run_dir / "events.jsonl", run_id=run_id, proc=proc
+        )
+        if meta:
+            self.event("run_meta", meta=meta)
+
+    # ------------------------------------------------------------- emitters
+
+    def event(self, kind: str, **payload) -> dict:
+        return self.sink.emit(kind, **payload)
+
+    def counter(self, name: str):
+        return self.registry.counter(name)
+
+    def gauge(self, name: str):
+        return self.registry.gauge(name)
+
+    def histogram(self, name: str):
+        return self.registry.histogram(name)
+
+    def sample_memory(self, epoch: int | None = None) -> dict | None:
+        """Gauge + event for device memory and live buffers (host-side
+        metadata reads only — no device sync)."""
+        snap = device_memory_snapshot()
+        if snap is None:
+            return None
+        for key in ("bytes_in_use", "peak_bytes_in_use", "live_buffer_bytes"):
+            if snap.get(key) is not None:
+                self.gauge(f"device/{key}").set(snap[key])
+        self.gauge("device/live_buffers").set(snap["live_buffers"])
+        self.event("memory", epoch=epoch, **snap)
+        return snap
+
+    def snapshot_metrics(self) -> dict:
+        """Emit the registry's final aggregate state as a ``metrics`` event."""
+        snap = self.registry.snapshot()
+        self.event("metrics", **snap)
+        return snap
+
+    def close(self) -> None:
+        self.sink.close()
+
+
+def device_memory_snapshot() -> dict | None:
+    """Device memory stats summed over devices, with a live-buffer fallback.
+
+    ``memory_stats()`` is backend-dependent (TPU reports bytes_in_use /
+    peak_bytes_in_use; the CPU client usually reports nothing), so the
+    snapshot always also carries the bytes of live ``jax.Array``\\ s — an
+    upper-bound proxy that exists on every backend. Returns None when jax
+    was never imported (pure host tooling must not pull it in).
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    in_use = peak = None
+    source = "live_arrays"
+    try:
+        for dev in jax.devices():
+            stats = dev.memory_stats()
+            if not stats:
+                continue
+            if "bytes_in_use" in stats:
+                in_use = (in_use or 0) + int(stats["bytes_in_use"])
+                source = "memory_stats"
+            if "peak_bytes_in_use" in stats:
+                peak = (peak or 0) + int(stats["peak_bytes_in_use"])
+    except Exception:  # a wedged/odd backend must not kill the run
+        pass
+    live_bytes = 0
+    live_count = 0
+    try:
+        for arr in jax.live_arrays():
+            live_count += 1
+            live_bytes += int(getattr(arr, "nbytes", 0) or 0)
+    except Exception:
+        pass
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "live_buffer_bytes": live_bytes,
+        "live_buffers": live_count,
+        "source": source,
+    }
+
+
+class CompileTracker:
+    """Counts XLA compiles of a jitted callable via cache-miss deltas.
+
+    ``poll()`` returns how many new executables the function's jit cache
+    gained since the last poll — 1 after the warmup epoch, 0 in steady
+    state, >0 exactly when the program's signature leaked (the TA201 bug
+    class) and the run silently recompiled.
+    """
+
+    def __init__(self, fn, size_fn: Callable | None = None):
+        self._fn = fn
+        self._size_fn = size_fn or _default_cache_size
+        self._last = self._size() or 0
+        self.total = 0
+
+    def _size(self) -> int | None:
+        try:
+            return self._size_fn(self._fn)
+        except Exception:
+            return None
+
+    def poll(self) -> int:
+        size = self._size()
+        if size is None:
+            return 0
+        delta = max(0, size - self._last)
+        self._last = size
+        self.total += delta
+        return delta
+
+
+def _default_cache_size(fn) -> int | None:
+    size = getattr(fn, "_cache_size", None)
+    return size() if callable(size) else None
+
+
+class EpochRecorder:
+    """Turns the trainer's loop boundaries into ``epoch`` events.
+
+    Protocol per epoch: ``begin`` (finalizes the previous unfenced epoch
+    boundary-to-boundary) -> ``dispatched`` (host dispatch time, compile
+    delta, data wait) -> optionally ``fenced`` (exact device wait, only at
+    fences the trainer takes anyway) -> ... -> ``finish`` once after the
+    loop's closing ``block_until_ready``.
+    """
+
+    def __init__(
+        self,
+        tel: TelemetryRun,
+        steps_per_epoch: int,
+        on_epoch: Callable[[dict], None] | None = None,
+    ):
+        self.tel = tel
+        self.steps_per_epoch = steps_per_epoch
+        # Called with each finalized epoch event payload — the trainer uses
+        # it to mirror perf scalars into TensorBoard next to the loss curves.
+        self.on_epoch = on_epoch
+        self._open: dict | None = None  # the epoch awaiting its wall close
+        self._t0: float | None = None
+
+    # The trainer calls these in loop order; all are no-throw by design —
+    # a telemetry bug must never kill a training run.
+
+    def begin(self, epoch: int) -> None:
+        now = time.perf_counter()
+        self._finalize(now, fenced=False, device_s=None)
+        self._t0 = now
+        self._open = {"epoch": epoch}
+
+    def dispatched(
+        self, compiles: int = 0, data_wait_s: float = 0.0
+    ) -> None:
+        if self._open is None or self._t0 is None:
+            return
+        self._open["dispatch_s"] = time.perf_counter() - self._t0
+        self._open["compile_events"] = compiles
+        self._open["data_wait_s"] = data_wait_s
+        if compiles:
+            self.tel.counter("train/epoch_compiles").inc(compiles)
+        if data_wait_s:
+            self.tel.counter("data/get_wait_s").inc(data_wait_s)
+
+    def fenced(self, device_s: float) -> None:
+        self._finalize(time.perf_counter(), fenced=True, device_s=device_s)
+
+    def finish(self) -> None:
+        self._finalize(time.perf_counter(), fenced=True, device_s=None)
+
+    def _finalize(self, now: float, fenced: bool, device_s: float | None):
+        if self._open is None or self._t0 is None:
+            return
+        ev, self._open = self._open, None
+        wall = now - self._t0
+        self._t0 = None
+        steps = self.steps_per_epoch
+        compiled = bool(ev.get("compile_events"))
+        self.tel.counter("train/epochs").inc()
+        self.tel.counter("train/steps").inc(steps)
+        self.tel.histogram("train/epoch_wall_s").observe(wall)
+        if not compiled and steps > 0:
+            self.tel.histogram("train/step_time_s").observe(wall / steps)
+        payload = self.tel.event(
+            "epoch",
+            epoch=ev["epoch"],
+            steps=steps,
+            wall_s=wall,
+            dispatch_s=ev.get("dispatch_s"),
+            device_s=device_s,
+            data_wait_s=ev.get("data_wait_s", 0.0),
+            compile_events=ev.get("compile_events", 0),
+            compiled=compiled,
+            fenced=fenced,
+            steps_per_sec=(steps / wall) if wall > 0 else None,
+        )
+        if self.on_epoch is not None:
+            try:
+                self.on_epoch(payload)
+            except Exception:  # mirroring must never kill a training run
+                pass

@@ -5,14 +5,40 @@ scalar tags ``loss/{mse,nll,total}/{train,val}`` at src/model.py:207-208,
 254-255, 314-318; LR under ``lr-Adam`` via LearningRateMonitor
 train.py:162-165; final hparams + test metrics train.py:204-211; figures
 via ``add_figure`` test.py:94-145.)
+
+tensorboardX is optional: it is imported lazily on first write, and when
+absent the logger degrades to a warn-once no-op instead of breaking
+training — the telemetry event stream (telemetry/) is the durable record;
+TensorBoard is a mirror for humans. Scalar writes flush the underlying
+writer so curves are visible mid-run and survive a killed process without
+waiting for ``close()``.
 """
 
 from __future__ import annotations
 
+import sys
 from pathlib import Path
 from typing import Any
 
-from tensorboardX import SummaryWriter
+_MISSING_WARNED = False
+
+
+def _load_writer_cls():
+    """tensorboardX's SummaryWriter, or None (warn once) when unavailable."""
+    global _MISSING_WARNED
+    try:
+        from tensorboardX import SummaryWriter
+    except ImportError:
+        if not _MISSING_WARNED:
+            _MISSING_WARNED = True
+            print(
+                "masters_thesis_tpu: tensorboardX is not installed — "
+                "TensorBoard logging disabled (telemetry events.jsonl is "
+                "still written)",
+                file=sys.stderr,
+            )
+        return None
+    return SummaryWriter
 
 
 class TensorBoardLogger:
@@ -21,32 +47,53 @@ class TensorBoardLogger:
     def __init__(self, save_dir: str | Path, name: str, version: str):
         self.log_dir = Path(save_dir) / name / version
         self.log_dir.mkdir(parents=True, exist_ok=True)
-        self._writer: SummaryWriter | None = None
+        self._writer = None
+        self._disabled = False
 
     @property
-    def writer(self) -> SummaryWriter:
-        if self._writer is None:
-            self._writer = SummaryWriter(logdir=str(self.log_dir))
+    def writer(self):
+        """The lazy SummaryWriter, or None when tensorboardX is missing."""
+        if self._writer is None and not self._disabled:
+            cls = _load_writer_cls()
+            if cls is None:
+                self._disabled = True
+            else:
+                self._writer = cls(logdir=str(self.log_dir))
         return self._writer
 
     def log_scalar(self, tag: str, value: float, step: int) -> None:
-        self.writer.add_scalar(tag, float(value), step)
+        w = self.writer
+        if w is None:
+            return
+        w.add_scalar(tag, float(value), step)
+        w.flush()
 
     def log_scalars(self, scalars: dict[str, float], step: int) -> None:
+        w = self.writer
+        if w is None:
+            return
         for tag, value in scalars.items():
-            self.log_scalar(tag, value, step)
+            w.add_scalar(tag, float(value), step)
+        w.flush()
 
     def log_hparams(self, hparams: dict[str, Any], metrics: dict[str, float]) -> None:
         """Final hparams + metrics table (reference: train.py:204-211)."""
+        w = self.writer
+        if w is None:
+            return
         clean = {
             k: (v if isinstance(v, (int, float, str, bool)) else str(v))
             for k, v in hparams.items()
             if v is not None
         }
-        self.writer.add_hparams(clean, {k: float(v) for k, v in metrics.items()})
+        w.add_hparams(clean, {k: float(v) for k, v in metrics.items()})
+        w.flush()
 
     def log_figure(self, tag: str, figure, step: int = 0) -> None:
-        self.writer.add_figure(tag, figure, step)
+        w = self.writer
+        if w is None:
+            return
+        w.add_figure(tag, figure, step)
 
     def close(self) -> None:
         if self._writer is not None:

@@ -38,6 +38,21 @@ from masters_thesis_tpu.models.objectives import (
 from masters_thesis_tpu.parallel import DATA_AXIS, shard_map
 
 
+def jit_cache_size(fn) -> int | None:
+    """Compile-cache entry count of a jitted callable (None if unknown).
+
+    The jit layer owns this hook so every consumer agrees on what "the
+    program compiled once" means: the trace audit (analysis.traceaudit
+    TA201) asserts it preflight, and telemetry.CompileTracker counts the
+    deltas at runtime to detect signature leaks mid-run.
+    """
+    size = getattr(fn, "_cache_size", None)
+    try:
+        return size() if callable(size) else None
+    except Exception:  # a jit internals change must degrade, not crash
+        return None
+
+
 def forward_rows(module, params, x, dropout_rng=None):
     """Apply the encoder to a window batch: ``(B, K, T, F) -> (B, K, 1)`` x2.
 

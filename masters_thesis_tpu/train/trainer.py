@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from masters_thesis_tpu.data.pipeline import Batch, FinancialWindowDataModule
-from masters_thesis_tpu.data.prefetch import prefetch_to_device
+from masters_thesis_tpu.data.prefetch import PrefetchStats, prefetch_to_device
 from masters_thesis_tpu.models.objectives import ModelSpec
 from masters_thesis_tpu.parallel import (
     DATA_AXIS,
@@ -39,10 +39,17 @@ from masters_thesis_tpu.parallel import (
     global_put,
     make_data_mesh,
 )
+from masters_thesis_tpu.telemetry import (
+    CompileTracker,
+    EpochRecorder,
+    ProfilerWindow,
+    TelemetryRun,
+)
 from masters_thesis_tpu.train import checkpoint as ckpt_lib
 from masters_thesis_tpu.train.logging import TensorBoardLogger
 from masters_thesis_tpu.train.optim import PlateauScheduler, make_optimizer
 from masters_thesis_tpu.train.steps import (
+    jit_cache_size,
     make_eval_fn,
     make_train_epoch,
     make_train_step,
@@ -85,12 +92,14 @@ class Trainer:
         enable_progress_bar: bool = True,
         enable_model_summary: bool = True,
         profile: bool = False,
+        profile_steps: tuple[int, int] | None = None,
         logger: TensorBoardLogger | None = None,
         ckpt_dir: str | Path | None = None,
         seed: int = 0,
         name: str = "fast",
         resume: bool = False,
         preflight: bool = False,
+        telemetry: TelemetryRun | str | Path | None = None,
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
@@ -110,6 +119,14 @@ class Trainer:
         self.enable_progress_bar = enable_progress_bar
         self.enable_model_summary = enable_model_summary
         self.profile = profile
+        # profile_steps=(N, M) opens a jax.profiler capture window over
+        # epochs N..M (inclusive); the legacy profile=True flag maps to the
+        # first post-compile epoch at fit time.
+        self.profile_steps = (
+            (int(profile_steps[0]), int(profile_steps[1]))
+            if profile_steps is not None
+            else None
+        )
         self.logger = logger
         self.ckpt_dir = Path(ckpt_dir) if ckpt_dir else None
         self.seed = seed
@@ -120,6 +137,14 @@ class Trainer:
         # guard, sharding, dtype policy. Fails fast with a PreflightError
         # instead of training slowly/wrongly for hours.
         self.preflight = preflight
+        # Structured step-level telemetry (telemetry/): a run dir gets an
+        # events.jsonl stream readable by
+        # ``python -m masters_thesis_tpu.telemetry summarize``. A path
+        # constructs the run here; a TelemetryRun is shared with the caller
+        # (the caller owns close()).
+        if isinstance(telemetry, (str, Path)):
+            telemetry = TelemetryRun(telemetry)
+        self.telemetry = telemetry
 
     def _resolve_dtype(self, spec, dm):
         """Concrete compute dtype for this (model, window) shape.
@@ -198,17 +223,33 @@ class Trainer:
         checkpoint (reference: train.py:187 passes ckpt_path to fit);
         ``init_state=(params, None)`` warm-starts the weights with a fresh
         optimizer (the thesis' synthetic->real warmup protocol)."""
+        tel = self.telemetry
         if self.preflight:
             if self.epoch_mode == "scan":
                 from masters_thesis_tpu.analysis.traceaudit import (
+                    PreflightError,
                     assert_trace_clean,
                 )
 
                 self._print("preflight: trace audit on the fit mesh ...")
                 # Audits the configured model/objective on this trainer's
                 # mesh with tiny synthetic data — raises PreflightError
-                # before any real epoch runs.
-                assert_trace_clean(spec=spec, mesh=self.mesh)
+                # before any real epoch runs. The verdict is recorded as a
+                # telemetry event either way, so a failed preflight shows up
+                # in the run report, not only in a dead process' stderr.
+                try:
+                    assert_trace_clean(spec=spec, mesh=self.mesh)
+                except PreflightError as exc:
+                    if tel:
+                        tel.event(
+                            "preflight",
+                            status="failed",
+                            rules=sorted({f.rule for f in exc.findings}),
+                            findings=[f.format() for f in exc.findings],
+                        )
+                    raise
+                if tel:
+                    tel.event("preflight", status="ok")
                 self._print("preflight: ok")
             else:
                 # The stream mode's per-step program has host work (the
@@ -218,6 +259,11 @@ class Trainer:
                     "preflight: skipped (epoch_mode='stream' streams batches "
                     "through the host by design)"
                 )
+                if tel:
+                    tel.event(
+                        "preflight", status="skipped",
+                        reason="epoch_mode=stream",
+                    )
         dm.prepare_data(verbose=self.enable_progress_bar)
         dm.setup("fit")
 
@@ -303,6 +349,11 @@ class Trainer:
             )
         eval_fn = make_eval_fn(module, objective, self.mesh)
 
+        # Stream mode fills a fresh PrefetchStats per epoch so telemetry can
+        # split epoch wall into device time vs host data-wait; scan mode has
+        # no input pipeline (the split is device-resident).
+        epoch_stats: dict[str, PrefetchStats | None] = {"cur": None}
+
         if self.epoch_mode == "scan":
             train_dev, n_local = self._device_train_split(dm.train_arrays())
             b_local = dm.batch_size
@@ -311,6 +362,7 @@ class Trainer:
                 module, objective, spec.metric_keys, tx, self.mesh,
                 batch_size=b_local,
             )
+            hot_fn = epoch_fn
 
             def run_epoch(params, opt_state, lr, epoch_rng, epoch):
                 # Shuffle happens on device (steps.py) — no index upload.
@@ -329,6 +381,7 @@ class Trainer:
             step_fn = make_train_step(
                 module, objective, tx, self.mesh, weighted=True
             )
+            hot_fn = step_fn
             shard = batch_sharding(self.mesh)
 
             def weighted_batches(batches):
@@ -346,11 +399,15 @@ class Trainer:
 
             def run_epoch(params, opt_state, lr, epoch_rng, epoch):
                 sums = None
+                stats = PrefetchStats()
+                epoch_stats["cur"] = stats
                 it = dm._iterate(
                     dm.train_range, global_b, shuffle_seed=(self.seed, epoch)
                 )
                 for i, (batch, w) in enumerate(
-                    prefetch_to_device(weighted_batches(it), sharding=shard)
+                    prefetch_to_device(
+                        weighted_batches(it), sharding=shard, stats=stats
+                    )
                 ):
                     step_rng = jax.random.fold_in(epoch_rng, i)
                     params, opt_state, step_sums = step_fn(
@@ -365,6 +422,56 @@ class Trainer:
 
         else:
             raise ValueError(f"unknown epoch_mode: {self.epoch_mode!r}")
+
+        # ---- telemetry wiring: event stream, compile trackers, recorder ----
+        # Compile events are measured, not inferred: cache-miss deltas on
+        # the hot program (scan epoch / stream step) and on eval_fn turn
+        # tracelint's TA201 "compiles exactly once" into a runtime counter.
+        epoch_tracker = eval_tracker = rec = None
+        if tel:
+            tel.event(
+                "run_started",
+                platform=jax.default_backend(),
+                n_devices=self.n_dev,
+                strategy=self.strategy,
+                epoch_mode=self.epoch_mode,
+                steps_per_epoch=steps_per_epoch,
+                max_epochs=self.max_epochs,
+                start_epoch=start_epoch,
+                objective=spec.objective,
+                trainer=self.name,
+                seed=self.seed,
+            )
+            epoch_tracker = CompileTracker(hot_fn, size_fn=jit_cache_size)
+            eval_tracker = CompileTracker(eval_fn, size_fn=jit_cache_size)
+
+            def _mirror_epoch(ev):
+                # Perf scalars land next to the loss curves in TensorBoard.
+                if self.logger and ev.get("steps_per_sec") is not None:
+                    self.logger.log_scalars(
+                        {
+                            "perf/epoch_wall_s": ev["wall_s"],
+                            "perf/steps_per_sec": ev["steps_per_sec"],
+                        },
+                        ev["epoch"],
+                    )
+
+            rec = EpochRecorder(tel, steps_per_epoch, on_epoch=_mirror_epoch)
+
+        window = self.profile_steps
+        if window is None and self.profile:
+            # Legacy profile=True: capture the first post-compile epoch.
+            window = (start_epoch + 1, start_epoch + 1)
+        prof = ProfilerWindow(
+            window,
+            (
+                tel.run_dir
+                if tel
+                else (self.logger.log_dir if self.logger else Path("logs"))
+            )
+            / "profile",
+            telemetry=tel,
+        )
 
         history: list[dict] = []
         total_steps = 0
@@ -423,14 +530,16 @@ class Trainer:
                 halt(row)
             return bad
 
-        trace_open = False
+        def fence():
+            jax.block_until_ready(params)
+
         for epoch in range(start_epoch, self.max_epochs):
-            if self.profile and epoch == start_epoch + 1:
-                jax.profiler.start_trace(
-                    str((self.logger.log_dir if self.logger else Path("logs"))
-                        / "profile")
-                )
-                trace_open = True
+            prof.maybe_start(epoch)
+            if rec:
+                # Closes the previous unfenced epoch boundary-to-boundary
+                # (the async-dispatch-aware accounting in telemetry/run.py)
+                # — never an added fence in the steady-state hot loop.
+                rec.begin(epoch)
             epoch_rng = jax.random.fold_in(dropout_rng, epoch)
             lr = jnp.float32(scheduler.lr)
             params, opt_state, sums = run_epoch(
@@ -440,6 +549,21 @@ class Trainer:
             # 'lr-Adam' matches the reference's LearningRateMonitor scalar
             # tag (reference: train.py:162-165 names it lr-<optimizer>).
             row = {"epoch": epoch, "lr-Adam": scheduler.lr}
+
+            if rec:
+                stats = epoch_stats["cur"]
+                rec.dispatched(
+                    compiles=epoch_tracker.poll(),
+                    data_wait_s=stats.get_wait_s if stats else 0.0,
+                )
+                if stats:
+                    tel.counter("data/batches").inc(stats.gets)
+                    tel.gauge("data/prefetch_mean_depth").set(stats.mean_depth)
+                    if stats.min_depth is not None:
+                        tel.gauge("data/prefetch_min_depth").set(
+                            stats.min_depth
+                        )
+                    epoch_stats["cur"] = None
 
             # Previous epoch's readback overlaps this epoch's execution.
             if pending is not None:
@@ -452,8 +576,15 @@ class Trainer:
                 (epoch + 1) % self.check_val_every_n_epoch == 0
                 and val_prepared
             )
-            if is_val or t_start is None or self.profile:
+            if is_val or t_start is None or prof.wants_fence(epoch):
+                # This readback blocks on the epoch's device sums — the only
+                # fences in the loop, and all at boundaries the trainer
+                # needs anyway (val sync, compile watermark, profile window).
+                t_fence = time.perf_counter()
                 diverged = readback(row, sums)
+                if rec:
+                    rec.fenced(time.perf_counter() - t_fence)
+                    tel.sample_memory(epoch)
                 if t_start is None:  # first epoch readback = compile done
                     t_start = time.perf_counter()
                 if diverged:
@@ -467,6 +598,13 @@ class Trainer:
                         {f"loss/{k}/val": v for k, v in val_metrics.items()}
                     )
                     val_loss = val_metrics["total"]
+                    if rec:
+                        tel.event(
+                            "eval",
+                            epoch=epoch,
+                            compile_events=eval_tracker.poll(),
+                            val_loss=float(val_loss),
+                        )
                     row["lr-Adam"] = scheduler.step(val_loss)
                     if val_loss < best_val:
                         best_val = val_loss
@@ -478,21 +616,19 @@ class Trainer:
             else:
                 pending = (row, sums)
 
-            if trace_open and epoch == start_epoch + 1:
-                jax.block_until_ready(params)
-                jax.profiler.stop_trace()
-                trace_open = False
+            prof.maybe_stop(epoch, fence)
 
         # A divergence break can exit mid-profiled-epoch: close the trace so
         # the diagnostic data is written out rather than lost.
-        if trace_open:
-            jax.block_until_ready(params)
-            jax.profiler.stop_trace()
+        prof.close(fence)
 
         if pending is not None and not diverged:
             diverged = drain(pending)
 
         jax.block_until_ready(params)
+        if rec:
+            # The loop's closing fence above is the final epoch's boundary.
+            rec.finish()
         elapsed = time.perf_counter() - (t_start or time.perf_counter())
         post_compile_steps = total_steps - steps_per_epoch
         steps_per_sec = (
@@ -516,6 +652,25 @@ class Trainer:
         if self.ckpt_dir and not diverged:
             self._save("last", params, opt_state, spec, self.max_epochs - 1,
                        best_val, dm, scheduler, best_val)
+
+        if tel:
+            tel.sample_memory(None)
+            tel.event(
+                "run_finished",
+                epochs=len(history),
+                total_steps=total_steps,
+                steps_per_sec=steps_per_sec,
+                diverged=diverged,
+                best_val=float(best_val) if np.isfinite(best_val) else None,
+                epoch_compiles=epoch_tracker.total,
+                eval_compiles=eval_tracker.total,
+            )
+            tel.snapshot_metrics()
+            if self.logger:
+                self.logger.log_scalars(
+                    {"perf/steps_per_sec": steps_per_sec},
+                    self.max_epochs - 1,
+                )
 
         return TrainResult(
             params=params,
@@ -543,6 +698,11 @@ class Trainer:
         if self.logger:
             self.logger.log_scalars(
                 {f"test/{k}": v for k, v in metrics.items()}, 0
+            )
+        if self.telemetry:
+            self.telemetry.event(
+                "test",
+                metrics={k: float(v) for k, v in metrics.items()},
             )
         return metrics
 

@@ -291,11 +291,47 @@ def run_cell(
         return
     row = json.loads(ev.stdout.strip().splitlines()[-1])
     row.update({"cell": cell, "train_wall_s": round(wall, 1),
-                "truncated": truncated})
+                "truncated": truncated,
+                "telemetry": telemetry_summary(ckpt)})
     RESULTS_DIR.mkdir(exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
     log(f"{cell}: recorded (wall {wall:.0f}s, truncated={truncated})")
+
+
+def telemetry_summary(ckpt: Path) -> dict | None:
+    """Perf summary of the cell's training run, from its telemetry stream.
+
+    train.py (trainer.telemetry=auto) writes <log_dir>/telemetry/events.jsonl
+    next to <log_dir>/checkpoints/<tag>; the summarize CLI is jax-free, so
+    this never touches (or hangs on) the backend. Returns the headline
+    numbers worth a grid row — or None when the run predates telemetry.
+    """
+    tel_dir = ckpt.parent.parent / "telemetry"
+    if not (tel_dir / "events.jsonl").exists():
+        return None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+             "summarize", str(tel_dir), "--json"],
+            cwd=REPO,
+            timeout=120,
+            capture_output=True,
+            text=True,
+        )
+        report = json.loads(out.stdout)
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError) as exc:
+        log(f"telemetry summary failed for {tel_dir}: {type(exc).__name__}")
+        return None
+    return {
+        "steps_per_sec": report.get("steps_per_sec"),
+        "step_time_ms_p50": report.get("step_time_ms", {}).get("p50"),
+        "step_time_ms_p99": report.get("step_time_ms", {}).get("p99"),
+        "compiles": report.get("compiles", {}).get("train_epoch"),
+        "data_wait_s": report.get("data", {}).get("data_wait_s"),
+        "peak_bytes": report.get("memory", {}).get("peak_bytes"),
+        "violations": report.get("violations"),
+    }
 
 
 def main() -> None:

@@ -1,0 +1,313 @@
+"""Telemetry tests: registry/event primitives, the async-dispatch-aware
+trainer wiring on the 8-device virtual mesh, and the summarize CLI.
+
+The e2e contract (ISSUE acceptance): a tiny run must produce an
+events.jsonl from which ``summarize`` reports steps/sec, p50/p99 step
+time, a compile count of exactly 1 (TA201 at runtime), the data-wait vs
+device-time split, and peak device memory — and an intentionally
+shape-varying run must be flagged (CLI exit 2).
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+from masters_thesis_tpu.data.prefetch import PrefetchStats, prefetch_to_device
+from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.telemetry import (
+    CompileTracker,
+    EpochRecorder,
+    EventSink,
+    MetricsRegistry,
+    TelemetryRun,
+    read_events,
+)
+from masters_thesis_tpu.telemetry.__main__ import main as cli_main
+from masters_thesis_tpu.telemetry.report import summarize_path
+from masters_thesis_tpu.train import Trainer
+from masters_thesis_tpu.train.steps import jit_cache_size
+
+
+@pytest.fixture(scope="module")
+def tiny_dm(tmp_path_factory) -> FinancialWindowDataModule:
+    data_dir = tmp_path_factory.mktemp("tel_data")
+    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+        n_stocks=8, n_samples=4000, seed=1
+    )
+    np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+    np.save(data_dir / "market.npy", np.asarray(r_market))
+    np.save(data_dir / "alphas.npy", np.asarray(alphas))
+    np.save(data_dir / "betas.npy", np.asarray(betas))
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24, batch_size=2
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    return dm
+
+
+def small_spec():
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        learning_rate=1e-2,
+    )
+
+
+def make_trainer(**kw):
+    defaults = dict(
+        max_epochs=2,
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=1,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+        strategy="tpu_xla",
+    )
+    defaults.update(kw)
+    return Trainer(**defaults)
+
+
+# --------------------------------------------------------------- primitives
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2.5)
+        reg.gauge("g").set(7)
+        for v in range(100):
+            reg.histogram("h").observe(float(v))
+        snap = reg.snapshot()
+        assert snap["metrics"]["c"]["value"] == 3.5
+        assert snap["metrics"]["g"]["value"] == 7.0
+        h = snap["metrics"]["h"]
+        assert h["count"] == 100 and h["min"] == 0.0 and h["max"] == 99.0
+        assert h["p50"] is not None and h["p99"] is not None
+        assert "host" in snap["tags"] and "pid" in snap["tags"]
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().counter("c").inc(-1)
+
+    def test_type_clash_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_histogram_bounded_memory(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h")
+        for v in range(100_000):
+            h.observe(float(v))
+        assert len(h._samples) < h._max_samples
+        assert h.count == 100_000
+        # Decimation keeps the sample spread over the run, not clustered.
+        assert h.quantile(0.99) > h.quantile(0.5) > 0
+
+
+class TestEvents:
+    def test_envelope_and_roundtrip(self, tmp_path):
+        sink = EventSink(tmp_path / "events.jsonl", run_id="r1", proc=0)
+        sink.emit("alpha", value=1)
+        sink.emit("beta", nested={"a": [1, 2]})
+        sink.close()
+        events = read_events(tmp_path / "events.jsonl")
+        assert [e["kind"] for e in events] == ["alpha", "beta"]
+        assert events[0]["run"] == "r1" and events[0]["seq"] == 0
+        assert events[1]["seq"] == 1 and events[1]["nested"] == {"a": [1, 2]}
+
+    def test_payload_envelope_clash_rejected(self, tmp_path):
+        sink = EventSink(tmp_path / "e.jsonl", run_id="r")
+        with pytest.raises(ValueError):
+            sink.emit("x", run="spoofed")
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        path = tmp_path / "e.jsonl"
+        sink = EventSink(path, run_id="r")
+        sink.emit("ok")
+        sink.close()
+        with open(path, "a") as f:
+            f.write('{"kind": "torn", "no_clos')  # SIGKILL mid-write
+        events = read_events(path)
+        assert len(events) == 1 and events[0]["kind"] == "ok"
+
+
+class TestPrefetchStats:
+    def test_counts_and_depth(self):
+        stats = PrefetchStats()
+        items = [np.ones((2,)) for _ in range(5)]
+        out = list(prefetch_to_device(iter(items), size=2, stats=stats))
+        assert len(out) == 5
+        assert stats.gets == 5 and stats.yields == 5
+        assert stats.exhausted
+        assert stats.get_wait_s > 0
+        assert stats.min_depth >= 1 and stats.mean_depth >= 1
+
+
+# ------------------------------------------------------------------ e2e fit
+
+
+class TestTrainerTelemetry:
+    @pytest.fixture(scope="class")
+    def fit_report(self, tiny_dm, tmp_path_factory):
+        run_dir = tmp_path_factory.mktemp("tel_run")
+        tel = TelemetryRun(run_dir, run_id="e2e")
+        trainer = make_trainer(telemetry=tel)
+        result = trainer.fit(small_spec(), tiny_dm)
+        tel.close()
+        return run_dir, summarize_path(run_dir), result
+
+    def test_compiles_exactly_once(self, fit_report):
+        _, report, _ = fit_report
+        assert report["compiles"]["train_epoch"] == 1
+        assert report["violations"] == []
+
+    def test_throughput_and_step_quantiles(self, fit_report):
+        _, report, result = fit_report
+        assert report["steps_per_sec"] == pytest.approx(
+            result.steps_per_sec, rel=1e-6
+        )
+        assert report["steps_per_sec"] > 0
+        assert report["step_time_ms"]["p50"] > 0
+        assert report["step_time_ms"]["p99"] >= report["step_time_ms"]["p50"]
+
+    def test_time_split_and_memory(self, fit_report):
+        _, report, _ = fit_report
+        t = report["time_split_s"]
+        # Scan mode: the split is device-resident, so data-wait is 0 and
+        # the first (compile) epoch dominates total wall.
+        assert t["compile"] > 0 and t["total"] >= t["compile"]
+        assert t["device"] > 0  # val epochs carry exact fenced device time
+        assert t["data_wait"] == 0.0
+        assert report["data"]["starvation_pct"] == 0.0
+        assert report["memory"]["peak_bytes"] > 0
+
+    def test_epoch_events_are_fenced_only_at_boundaries(self, fit_report):
+        run_dir, _, _ = fit_report
+        events = read_events(run_dir / "events.jsonl")
+        epochs = [e for e in events if e["kind"] == "epoch"]
+        assert len(epochs) == 2
+        assert epochs[0]["compiled"] and epochs[0]["compile_events"] == 1
+        assert not epochs[1]["compiled"]
+        # check_val_every_n_epoch=1: every epoch is a val fence the trainer
+        # takes anyway — telemetry must mark them fenced with device time.
+        assert all(e["fenced"] and e["device_s"] is not None for e in epochs)
+        kinds = {e["kind"] for e in events}
+        assert {"run_started", "run_finished", "eval", "memory",
+                "metrics"} <= kinds
+
+    def test_cli_exit_codes(self, fit_report, capsys, tmp_path):
+        run_dir, _, _ = fit_report
+        assert cli_main(["summarize", str(run_dir)]) == 0
+        out = capsys.readouterr().out
+        assert "steps/sec" in out and "contracts      : ok" in out
+        assert cli_main(["summarize", str(run_dir), "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["compiles"]["train_epoch"] == 1
+        assert cli_main(["summarize", str(tmp_path / "nope")]) == 1
+
+    def test_selfcheck(self, capsys):
+        assert cli_main(["selfcheck"]) == 0
+        assert "selfcheck ok" in capsys.readouterr().out
+
+
+class TestShapeVaryingRunFlagged:
+    def test_recompiles_flagged(self, tmp_path, capsys):
+        """A run whose jitted program recompiles every epoch (the TA201
+        shape-leak bug class) must be flagged by summarize (exit 2)."""
+
+        @jax.jit
+        def step(x):
+            return x * 2.0
+
+        tel = TelemetryRun(tmp_path, run_id="shapeleak")
+        tel.event("run_started", platform="cpu", n_devices=1,
+                  strategy="single_device", epoch_mode="scan",
+                  steps_per_epoch=4)
+        tracker = CompileTracker(step, size_fn=jit_cache_size)
+        rec = EpochRecorder(tel, steps_per_epoch=4)
+        for epoch in range(3):
+            rec.begin(epoch)
+            # Shape varies per epoch -> a fresh executable every time.
+            jax.block_until_ready(step(jnp.zeros((epoch + 1,))))
+            rec.dispatched(compiles=tracker.poll())
+        rec.finish()
+        tel.close()
+        assert tracker.total == 3
+
+        report = summarize_path(tmp_path)
+        assert report["compiles"]["train_epoch"] == 3
+        assert any("recompile" in v for v in report["violations"])
+        assert cli_main(["summarize", str(tmp_path)]) == 2
+        assert "CONTRACT VIOLATIONS" in capsys.readouterr().out
+
+
+class TestStreamModeDataWait:
+    def test_data_wait_recorded(self, tiny_dm, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="stream")
+        trainer = make_trainer(epoch_mode="stream", telemetry=tel)
+        trainer.fit(small_spec(), tiny_dm)
+        tel.close()
+        report = summarize_path(tmp_path)
+        # Stream mode produces batches on the host: the wall-time split must
+        # show a nonzero data-wait, and the registry must carry the
+        # prefetch queue gauges.
+        assert report["data"]["data_wait_s"] > 0
+        assert report["violations"] == []
+        events = read_events(tmp_path / "events.jsonl")
+        metrics = [e for e in events if e["kind"] == "metrics"][-1]["metrics"]
+        assert metrics["data/batches"]["value"] > 0
+        assert metrics["data/prefetch_mean_depth"]["value"] >= 0
+
+
+class TestPreflightEvent:
+    def test_preflight_ok_recorded(self, tiny_dm, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="pre")
+        trainer = make_trainer(preflight=True, telemetry=tel)
+        trainer.fit(small_spec(), tiny_dm)
+        tel.close()
+        report = summarize_path(tmp_path)
+        assert report["preflight"] == "ok"
+        assert report["violations"] == []
+
+
+class TestProfileWindow:
+    def test_profile_steps_window(self, tiny_dm, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="prof")
+        trainer = make_trainer(
+            max_epochs=3, profile_steps=(1, 1), telemetry=tel
+        )
+        trainer.fit(small_spec(), tiny_dm)
+        tel.close()
+        traces = list((tmp_path / "profile").rglob("*.xplane.pb"))
+        assert traces, "no profiler trace under the telemetry run dir"
+        report = summarize_path(tmp_path)
+        assert report["profile_windows"] == [
+            {"start_epoch": 1, "end_epoch": 1,
+             "trace_dir": str(tmp_path / "profile")}
+        ]
+
+
+class TestLoggerDegradesWithoutTensorboardX:
+    def test_no_tensorboardx_is_noop(self, tmp_path, monkeypatch):
+        from masters_thesis_tpu.train import logging as tblog
+
+        # None in sys.modules makes `from tensorboardX import ...` raise
+        # ImportError — the exact shape of a missing optional dep.
+        monkeypatch.setitem(sys.modules, "tensorboardX", None)
+        monkeypatch.setattr(tblog, "_MISSING_WARNED", False)
+        logger = tblog.TensorBoardLogger(tmp_path, "x", "v0")
+        logger.log_scalar("a", 1.0, 0)
+        logger.log_scalars({"b": 2.0}, 0)
+        logger.log_hparams({"h": 1}, {"m": 0.5})
+        logger.close()
+        assert logger.writer is None
+        assert not list(logger.log_dir.glob("events.out.tfevents*"))

@@ -21,11 +21,15 @@ fi
 echo "== tracelint =="
 JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
 
+# 3. telemetry: hermetic registry -> events -> report smoke (jax-free).
+echo "== telemetry selfcheck =="
+python -m masters_thesis_tpu.telemetry selfcheck || fail=1
+
 if [ "${1:-}" = "--fast" ]; then
     exit $fail
 fi
 
-# 3. Tier-1 tests (the ROADMAP.md quick loop).
+# 4. Tier-1 tests (the ROADMAP.md quick loop).
 echo "== pytest (tier 1) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -147,6 +147,19 @@ def run(cfg: Config) -> float:
     ckpt_dir = logger.log_dir / "checkpoints"
 
     t = cfg.trainer
+    # trainer.telemetry: 'auto' puts the run's events.jsonl next to the TB
+    # logs; an explicit path pins the run dir; null/false disables.
+    tel_cfg = t.get("telemetry", None)
+    telemetry = None
+    if tel_cfg:
+        from masters_thesis_tpu.telemetry import TelemetryRun
+
+        telemetry = TelemetryRun(
+            logger.log_dir / "telemetry"
+            if tel_cfg == "auto"
+            else Path(tel_cfg)
+        )
+    profile_steps = t.get("profile_steps", None)
     trainer = Trainer(
         max_epochs=t.max_epochs,
         gradient_clip_val=t.gradient_clip_val,
@@ -158,11 +171,14 @@ def run(cfg: Config) -> float:
         enable_progress_bar=t.enable_progress_bar,
         enable_model_summary=t.enable_model_summary,
         profile=t.get("profile", False),
+        profile_steps=tuple(profile_steps) if profile_steps else None,
         logger=logger,
         ckpt_dir=ckpt_dir,
         seed=cfg.seed,
         name=t.name,
         resume=t.get("resume", False),
+        preflight=t.get("preflight", False),
+        telemetry=telemetry,
     )
 
     init_state = None
@@ -212,6 +228,12 @@ def run(cfg: Config) -> float:
         },
     )
     logger.close()
+    if telemetry is not None:
+        telemetry.close()
+        print(
+            "telemetry: python -m masters_thesis_tpu.telemetry summarize "
+            f"{telemetry.run_dir}"
+        )
     print(
         f"done: best_val={result.best_val_loss:.6g} "
         f"test_mae={test_metrics.get('mae', float('nan')):.6g} "
