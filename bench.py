@@ -318,7 +318,22 @@ def _point_child(objective: str, batch_size: int, epochs: int) -> None:
     dm.prepare_data(verbose=False)
     dm.setup()
     tel = _point_telemetry(objective, batch_size)
+    rec = None
+    if tel is None:
+        # No telemetry run to hang the recorder off — attach a standalone
+        # one under the parent-chosen dir (MTT_FLIGHTREC_DIR) so a watchdog
+        # SIGTERM still leaves a crashdump explaining where the point died.
+        flight_dir = os.environ.get("MTT_FLIGHTREC_DIR")
+        if flight_dir:
+            from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
+
+            rec = FlightRecorder(flight_dir)
+            rec.beat(phase="point")
+    # With telemetry on, Trainer.fit attaches the recorder to tel's run dir
+    # itself (telemetry/run.py attach_flight_recorder is idempotent).
     sps = _measure(dm, objective, epochs, telemetry=tel)
+    if rec is not None:
+        rec.close()
     if tel is not None:
         tel.close()
     import jax
@@ -331,50 +346,113 @@ def _point_child(objective: str, batch_size: int, epochs: int) -> None:
     }))
 
 
+# After a watchdog timeout the child gets SIGTERM and this long to write
+# its crashdump before SIGKILL. The flight recorder's dump is sub-second;
+# the margin covers a loaded host.
+TERM_GRACE_S = 15.0
+
+
+def _point_crash_dir(objective: str, batch_size: int) -> Path:
+    """Where a point child's flight recorder writes crashdump/heartbeat:
+    the point's telemetry run dir when --telemetry-dir is on (the recorder
+    attaches there), else a dedicated dir under data/."""
+    root = os.environ.get("MTT_TELEMETRY_DIR")
+    base = (
+        Path(root)
+        if root
+        else Path(__file__).resolve().parent / "data" / "bench_crash"
+    )
+    return base / f"point_{objective}_bs{batch_size}"
+
+
+def _failure(
+    objective: str, batch_size: int, reason: str, rc: int | None,
+    stdout: str | None, stderr: str | None,
+) -> dict:
+    """A failed point's record: what died, its output tails, and the
+    child's crashdump when the flight recorder got one out. This is what
+    MULTICHIP-style point records previously lost (always-empty "tail")."""
+    tail = "\n".join(
+        f"[{name}] {text[-500:].strip()}"
+        for name, text in (("stdout", stdout), ("stderr", stderr))
+        if text and text.strip()
+    )
+    crash = _point_crash_dir(objective, batch_size) / "crashdump.json"
+    record = {
+        "failed": True,
+        "point": f"{objective}/bs={batch_size}",
+        "reason": reason,
+        "rc": rc,
+        "tail": tail,
+        "crashdump": str(crash) if crash.exists() else None,
+    }
+    print(
+        f"point {record['point']} {reason}"
+        + (f" rc={rc}" if rc is not None else "")
+        + (f"; crashdump: {record['crashdump']}" if record["crashdump"]
+           else "")
+        + (f"\n{tail}" if tail else ""),
+        file=sys.stderr,
+    )
+    return record
+
+
+def _point_ok(point: dict | None) -> bool:
+    return point is not None and not point.get("failed")
+
+
 def _measure_point(
     objective: str, batch_size: int, epochs: int, timeout_s: float,
     force_cpu: bool = False,
 ) -> dict | None:
-    """Watchdogged measurement; None on hang/crash (logged, never raised).
+    """Watchdogged measurement; a failure record dict (``failed: True``,
+    with output tails and any crashdump path) on hang/crash — logged,
+    never raised. A hung child gets SIGTERM first so its flight recorder
+    dumps crashdump.json, then SIGKILL after TERM_GRACE_S.
 
     ``force_cpu`` pins the child to the CPU backend the only reliable way —
     via its environment, before its jax import — so the degraded fallback
     can never touch (and hang on) the wedged relay (ADVICE r4).
     """
-    env = _pin_cpu(dict(os.environ)) if force_cpu else None
+    env = _pin_cpu(dict(os.environ)) if force_cpu else dict(os.environ)
+    env["MTT_FLIGHTREC_DIR"] = str(_point_crash_dir(objective, batch_size))
+    proc = subprocess.Popen(
+        [sys.executable, __file__, "--point", objective,
+         str(batch_size), str(epochs)],
+        cwd=Path(__file__).resolve().parent,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    timed_out = False
     try:
-        out = subprocess.run(
-            [sys.executable, __file__, "--point", objective,
-             str(batch_size), str(epochs)],
-            cwd=Path(__file__).resolve().parent,
-            env=env,
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        print(
-            f"point {objective}/bs={batch_size} hung past {timeout_s:.0f}s "
-            "(mid-measurement relay wedge); skipping the section",
-            file=sys.stderr,
+        timed_out = True
+        proc.terminate()  # SIGTERM: let the flight recorder dump
+        try:
+            stdout, stderr = proc.communicate(timeout=TERM_GRACE_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()  # too wedged even to die; no dump is coming
+            stdout, stderr = proc.communicate()
+    if timed_out:
+        return _failure(
+            objective, batch_size,
+            f"hung past {timeout_s:.0f}s (mid-measurement relay wedge)",
+            proc.returncode, stdout, stderr,
         )
-        return None
-    if out.returncode != 0:
-        print(
-            f"point {objective}/bs={batch_size} failed rc={out.returncode}: "
-            f"{(out.stderr or '')[-500:]}",
-            file=sys.stderr,
+    if proc.returncode != 0:
+        return _failure(
+            objective, batch_size, "crashed", proc.returncode, stdout, stderr
         )
-        return None
     try:
-        return json.loads(out.stdout.strip().splitlines()[-1])
+        return json.loads((stdout or "").strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
-        print(
-            f"point {objective}/bs={batch_size} printed no JSON: "
-            f"{out.stdout[-300:]}",
-            file=sys.stderr,
+        return _failure(
+            objective, batch_size, "printed no JSON", proc.returncode,
+            stdout, stderr,
         )
-        return None
 
 
 def main() -> None:
@@ -418,15 +496,25 @@ def main() -> None:
         bench_tel.event(
             "bench_started", degraded=degraded, probe_attempts=probe_attempts
         )
+    # Failed point records (reason + output tails + crashdump path from the
+    # child's flight recorder) survive into detail.failures — the driver's
+    # per-round capture previously recorded such deaths as `"tail": ""`.
+    failures: list[dict] = []
+
+    def collect(point: dict | None) -> dict | None:
+        if point is not None and point.get("failed"):
+            failures.append(point)
+        return point
+
     headline = None
     if not degraded:
         # Healthy probe: all device-touching measurements run behind
         # watchdog subprocesses (a mid-measurement wedge must not hang
         # this process — see the watchdog comment above).
-        headline = _measure_point(
+        headline = collect(_measure_point(
             "mse", 1, MEASURE_EPOCHS, POINT_TIMEOUT_HEADLINE_S
-        )
-        if headline is None:
+        ))
+        if not _point_ok(headline):
             degraded = True
             _pin_cpu_in_process()
 
@@ -437,10 +525,10 @@ def main() -> None:
     # in-process only as a last resort, with the platform pinned.
     measure_epochs = 2 if degraded else MEASURE_EPOCHS
     if degraded:
-        point = _measure_point(
+        point = collect(_measure_point(
             "mse", 1, measure_epochs, POINT_TIMEOUT_AUX_S, force_cpu=True
-        )
-        if point is not None:
+        ))
+        if _point_ok(point):
             value = point["steps_per_sec"]
             windows_per_epoch = point["windows_per_epoch"]
             platform = point["platform"]
@@ -471,14 +559,16 @@ def main() -> None:
     scaling = None
     if not degraded:
         aux_epochs = max(2, MEASURE_EPOCHS // 2)
-        point = _measure_point("nll", 1, aux_epochs, POINT_TIMEOUT_AUX_S)
-        if point is not None:
+        point = collect(_measure_point("nll", 1, aux_epochs,
+                                       POINT_TIMEOUT_AUX_S))
+        if _point_ok(point):
             nll_sps = point["steps_per_sec"]
         # Batch sweep: amortizing the per-step dispatch floor. windows/sec
         # = steps/sec * batch_size, comparable across points.
         for bs in (8, 32):
-            point = _measure_point("mse", bs, aux_epochs, POINT_TIMEOUT_AUX_S)
-            if point is not None:
+            point = collect(_measure_point("mse", bs, aux_epochs,
+                                           POINT_TIMEOUT_AUX_S))
+            if _point_ok(point):
                 batch_sweep[str(bs)] = round(point["steps_per_sec"] * bs, 2)
         scaling = _run_scaling_subprocess()
     wall = time.perf_counter() - t0
@@ -511,6 +601,7 @@ def main() -> None:
             "scaling_fixed_global_batch": (
                 scaling.get("strong_fixed_global_batch") if scaling else None
             ),
+            "failures": failures,
         },
     }
     # The relay can wedge for HOURS (observed 2026-07-29: 3.5h+), far past

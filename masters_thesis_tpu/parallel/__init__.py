@@ -14,6 +14,7 @@ from masters_thesis_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     distributed_initialize,
+    distributed_run_context,
     global_put,
     make_data_mesh,
     replicated_sharding,
@@ -24,6 +25,7 @@ __all__ = [
     "DATA_AXIS",
     "batch_sharding",
     "distributed_initialize",
+    "distributed_run_context",
     "global_put",
     "make_data_mesh",
     "replicated_sharding",

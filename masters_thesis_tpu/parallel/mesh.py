@@ -20,6 +20,8 @@ multiple visible devices) and torchmetrics' NCCL metric reduction hook
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -93,6 +95,34 @@ def distributed_initialize(
                 "env (JAX_COORDINATOR_ADDRESS, process count, process id)"
             ) from exc
         # Single-process environment without coordinator metadata.
+        return
+    # Export identity into the env so child processes and jax-free host
+    # tooling (telemetry.run.process_identity, the fleet aggregator's
+    # workers) resolve the same process index this backend holds —
+    # setdefault, so an operator's explicit override wins.
+    try:
+        os.environ.setdefault("JAX_PROCESS_INDEX", str(jax.process_index()))
+        os.environ.setdefault("JAX_PROCESS_COUNT", str(jax.process_count()))
+    except Exception:
+        pass
+
+
+def distributed_run_context() -> dict:
+    """The fleet identity a run should stamp into its telemetry stream.
+
+    Safe both before and after ``jax.distributed`` init: prefers the live
+    backend's view, falls back to the cluster env the same way telemetry
+    does (``JAX_PROCESS_INDEX``/``MT_HOST_INDEX``), so a ``run_started``
+    event carries a usable identity in every launch mode.
+    """
+    from masters_thesis_tpu.telemetry.run import process_identity
+
+    proc, nproc = process_identity()
+    return {
+        "process_index": proc,
+        "process_count": nproc,
+        "coordinator": os.environ.get("JAX_COORDINATOR_ADDRESS"),
+    }
 
 
 def global_put(tree, sharding: NamedSharding):

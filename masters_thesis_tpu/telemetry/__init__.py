@@ -14,14 +14,24 @@ a program SHOULD do; this package measures what runs actually DO:
   memory / live-buffer sampling;
 - :mod:`profiling` — programmatic ``jax.profiler`` capture windows
   (``profile_steps=(N, M)``) under the run dir;
+- :mod:`flightrec` — in-process flight recorder: bounded event ring,
+  SIGTERM/SIGQUIT + hang-watchdog crashdumps (``crashdump.json``),
+  heartbeat files the fleet aggregator reads past a SIGKILL;
+- :mod:`aggregate` — cross-host stream merging: per-host epoch-time skew,
+  collective wait attribution, stragglers, exit-status reconstruction;
 - :mod:`report` + ``__main__`` — ``python -m masters_thesis_tpu.telemetry
-  summarize <run>``: steps/sec, p50/p99 step time, recompiles, time split,
-  starvation, peak memory; exits nonzero on contract violations.
+  summarize|aggregate|postmortem <run>``: single-run reports and fleet
+  postmortems; exit nonzero on contract violations / dead processes.
 
 Event schema and metric taxonomy: docs/telemetry.md.
 """
 
+from masters_thesis_tpu.telemetry.aggregate import (
+    aggregate_path,
+    postmortem_path,
+)
 from masters_thesis_tpu.telemetry.events import EventSink, read_events
+from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
 from masters_thesis_tpu.telemetry.profiling import ProfilerWindow
 from masters_thesis_tpu.telemetry.registry import (
     Counter,
@@ -41,11 +51,14 @@ __all__ = [
     "Counter",
     "EpochRecorder",
     "EventSink",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "ProfilerWindow",
     "TelemetryRun",
+    "aggregate_path",
     "device_memory_snapshot",
+    "postmortem_path",
     "read_events",
 ]

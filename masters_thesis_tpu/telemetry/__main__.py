@@ -6,16 +6,26 @@ Subcommands:
   ``events.jsonl`` file directly). Exit codes: 0 = ok, 1 = could not load,
   2 = the report shows contract violations (recompiles > 1, failed
   preflight, divergence) — so CI and the grid runner can gate on it.
+- ``aggregate <root>`` — merge every per-process ``events.jsonl`` under a
+  root into one fleet view: per-host epoch-time skew, collective wait
+  attribution, straggler identification, heartbeat gaps. Exit codes as
+  above (2 = the fleet has failures).
+- ``postmortem <root>`` — the aggregate view led by a one-line verdict on
+  how the run ended (which process died/hung/straggled and where). Exit 2
+  when any process died, hung, or stalled — so sweep runners and CI can
+  gate on it. ``--selfcheck`` runs a hermetic simulated-fleet smoke
+  instead (the tools/check.sh gate).
 - ``selfcheck`` — hermetic smoke of the whole pipeline (registry ->
   events -> report) in a temp dir; the tools/check.sh telemetry gate.
 
-Deliberately jax-free: summarize runs on operator machines where touching
-the backend can hang on a wedged relay lease (docs/OPERATIONS.md).
+Deliberately jax-free: these run on operator machines where touching the
+backend can hang on a wedged relay lease (docs/OPERATIONS.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import tempfile
 
@@ -34,6 +44,139 @@ def _summarize(args) -> int:
         return 1
     print(render_json(report) if args.json else render_text(report))
     return 2 if report["violations"] else 0
+
+
+def _aggregate(args) -> int:
+    from masters_thesis_tpu.telemetry.aggregate import (
+        aggregate_path,
+        render_fleet_text,
+    )
+
+    try:
+        report = aggregate_path(args.root, grace_s=args.grace)
+    except FileNotFoundError as exc:
+        print(f"aggregate: {exc}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(report, indent=2, default=str)
+        if args.json
+        else render_fleet_text(report)
+    )
+    return 0 if report["healthy"] else 2
+
+
+def _postmortem(args) -> int:
+    if args.selfcheck:
+        return _postmortem_selfcheck()
+    if args.root is None:
+        print("postmortem: a run root is required (or --selfcheck)",
+              file=sys.stderr)
+        return 1
+    from masters_thesis_tpu.telemetry.aggregate import (
+        postmortem_path,
+        render_fleet_text,
+    )
+
+    try:
+        report = postmortem_path(args.root, grace_s=args.grace)
+    except FileNotFoundError as exc:
+        print(f"postmortem: {exc}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(report, indent=2, default=str)
+        if args.json
+        else render_fleet_text(report, postmortem=True)
+    )
+    return report["exit_code"]
+
+
+def _postmortem_selfcheck() -> int:
+    """Hermetic smoke of the fleet pipeline: fabricate a healthy 2-process
+    run (must aggregate to exit 0) and a failed one whose p1 hung and
+    crash-dumped (postmortem must exit 2 and name p1). Jax-free — this is
+    the tools/check.sh gate for the aggregate/postmortem path."""
+    import os
+    from pathlib import Path
+
+    from masters_thesis_tpu.telemetry.aggregate import postmortem_path
+    from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
+    from masters_thesis_tpu.telemetry.run import TelemetryRun
+
+    def write_stream(root: Path, rank: int, epochs: int, finish: bool,
+                     wall: float) -> TelemetryRun:
+        os.environ["JAX_PROCESS_INDEX"] = str(rank)
+        os.environ["JAX_PROCESS_COUNT"] = "2"
+        tel = TelemetryRun(root / f"p{rank}", run_id=f"selfcheck-p{rank}")
+        tel.event("run_started", platform="cpu", n_devices=1,
+                  strategy="selfcheck", epoch_mode="scan", steps_per_epoch=4)
+        for epoch in range(epochs):
+            tel.event("epoch", epoch=epoch, steps=4, wall_s=wall,
+                      dispatch_s=0.01, device_s=None, data_wait_s=0.0,
+                      compile_events=0, compiled=False, fenced=False,
+                      steps_per_sec=4.0 / wall)
+        if finish:
+            tel.event("run_finished", epochs=epochs, total_steps=4 * epochs,
+                      steps_per_sec=4.0 / wall, diverged=False,
+                      best_val=0.5, epoch_compiles=1, eval_compiles=0)
+        return tel
+
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PROCESS_INDEX", "JAX_PROCESS_COUNT")}
+    failures: list[str] = []
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            healthy = Path(tmp) / "healthy"
+            for rank in range(2):
+                write_stream(healthy, rank, epochs=3, finish=True,
+                             wall=0.4 + 0.01 * rank).close()
+            report = postmortem_path(healthy)
+            if report["exit_code"] != 0:
+                failures.append(
+                    f"healthy fleet exited {report['exit_code']}: "
+                    f"{report['failures']}"
+                )
+            if report["epoch_skew"]["epochs_compared"] != 3:
+                failures.append(
+                    f"expected 3 shared epochs, got {report['epoch_skew']}"
+                )
+
+            wedged = Path(tmp) / "wedged"
+            write_stream(wedged, 0, epochs=3, finish=True, wall=0.4).close()
+            tel = write_stream(wedged, 1, epochs=2, finish=False, wall=0.4)
+            rec = FlightRecorder(
+                tel.run_dir, run_id=tel.run_id, sink=tel.sink,
+                heartbeat_interval_s=60.0, install_signal_handlers=False,
+                enable_faulthandler=False,
+            )
+            rec.beat(phase="train", epoch=2)
+            rec.dump("hang: no progress beat for 9.9s (selfcheck)")
+            rec.close()
+            tel.close()
+            report = postmortem_path(wedged)
+            if report["exit_code"] != 2:
+                failures.append(
+                    f"wedged fleet exited {report['exit_code']}, wanted 2"
+                )
+            if "p1" not in report["headline"]:
+                failures.append(
+                    f"headline does not name p1: {report['headline']!r}"
+                )
+            statuses = {d["label"]: d["status"]
+                        for d in report["processes"]}
+            if statuses.get("p1") != "hung":
+                failures.append(f"p1 status {statuses.get('p1')!r} != 'hung'")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if failures:
+        print("telemetry: postmortem selfcheck FAILED: "
+              + "; ".join(failures))
+        return 1
+    print("telemetry: postmortem selfcheck ok")
+    return 0
 
 
 def _selfcheck(args) -> int:
@@ -87,6 +230,41 @@ def main(argv: list[str] | None = None) -> int:
         "--json", action="store_true", help="machine-readable report"
     )
     p_sum.set_defaults(fn=_summarize)
+    p_agg = sub.add_parser(
+        "aggregate",
+        help="merge per-process event streams into one fleet view",
+    )
+    p_agg.add_argument(
+        "root", help="root directory holding per-process run dirs"
+    )
+    p_agg.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p_agg.add_argument(
+        "--grace", type=float, default=30.0, metavar="S",
+        help="treat processes active within S seconds as still running",
+    )
+    p_agg.set_defaults(fn=_aggregate)
+    p_post = sub.add_parser(
+        "postmortem",
+        help="fleet verdict on a dead/wedged run; exit 2 on failures",
+    )
+    p_post.add_argument(
+        "root", nargs="?", default=None,
+        help="root directory holding per-process run dirs",
+    )
+    p_post.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p_post.add_argument(
+        "--grace", type=float, default=30.0, metavar="S",
+        help="treat processes active within S seconds as still running",
+    )
+    p_post.add_argument(
+        "--selfcheck", action="store_true",
+        help="hermetic simulated-fleet smoke instead of reading a run",
+    )
+    p_post.set_defaults(fn=_postmortem)
     p_check = sub.add_parser(
         "selfcheck", help="hermetic registry->events->report smoke"
     )

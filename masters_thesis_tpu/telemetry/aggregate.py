@@ -1,0 +1,414 @@
+"""Fleet aggregation: merge per-host/per-process event streams into one
+view, and reconstruct how a multi-host run actually ended.
+
+A multi-host run produces N disjoint ``events.jsonl`` streams (one per
+process), plus the flight recorder's ``heartbeat.json`` and — when a
+process died with warning — ``crashdump.json`` next to each. This module
+folds all of them into a single fleet report:
+
+- per-host/per-process **epoch-time skew** over the epochs every stream
+  shares (the first straggler signal on real hardware);
+- **straggler identification** — the process whose epochs run longest,
+  with its slowdown vs the fleet median;
+- **collective wait attribution** — in a data-parallel psum world the
+  fast processes block in the collective for the slowest, so each
+  process's wait is the sum over shared epochs of (fleet max wall − own
+  wall). This is where "the TPU is slow" decomposes into "host 3 is
+  slow and everyone else is waiting on it";
+- **heartbeat gaps** — how far each process's last sign of life lags the
+  fleet's, which is the only evidence a SIGKILLed process leaves;
+- **exit-status reconstruction** — per process: ``finished`` /
+  ``killed`` (crashdump from a signal) / ``hung`` (crashdump from the
+  hang watchdog) / ``running`` (recent activity) / ``dead`` (started,
+  never finished, no recent activity — the SIGKILL case).
+
+Stdlib-only by contract, like :mod:`report`: the ``aggregate`` and
+``postmortem`` CLI subcommands run on operator machines where importing a
+backend can hang on a wedged relay lease (docs/OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+from masters_thesis_tpu.telemetry.events import read_events
+from masters_thesis_tpu.telemetry.flightrec import (
+    CRASHDUMP_FILENAME,
+    HEARTBEAT_FILENAME,
+)
+from masters_thesis_tpu.telemetry.report import EVENTS_FILENAME
+
+# A process whose last activity is within this window of "now" is treated
+# as still running rather than dead (live-run inspection vs postmortem).
+DEFAULT_GRACE_S = 30.0
+# A finished straggler is flagged when its shared-epoch wall exceeds the
+# fleet median by more than this fraction.
+STRAGGLER_SLOWDOWN = 0.10
+
+
+def discover_streams(root: str | Path) -> list[Path]:
+    """Every ``events.jsonl`` under ``root`` (or ``root`` itself if it is
+    one), sorted for deterministic process ordering."""
+    root = Path(root)
+    if root.is_file():
+        return [root]
+    if (root / EVENTS_FILENAME).is_file():
+        # A single-run dir may still have nested streams (bench roots hold
+        # point_*/ subruns); take the lot.
+        return sorted(root.rglob(EVENTS_FILENAME))
+    return sorted(root.rglob(EVENTS_FILENAME))
+
+
+def _read_json(path: Path) -> dict | None:
+    try:
+        obj = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def digest_stream(path: Path, root: Path) -> dict:
+    """Fold one process's stream (+ sidecar heartbeat/crashdump) into the
+    per-process digest the fleet report is built from."""
+    events = read_events(path)
+    by_kind: dict[str, list[dict]] = {}
+    proc = nproc = None
+    host = pid = None
+    for ev in events:
+        by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+        if proc is None and ev.get("proc") is not None:
+            proc = ev["proc"]
+        if ev.get("nproc") is not None:
+            nproc = max(nproc or 0, ev["nproc"])
+        host = host or ev.get("host")
+        pid = pid or ev.get("pid")
+    started = bool(by_kind.get("run_started"))
+    finished = (by_kind.get("run_finished") or [None])[-1]
+    epochs = by_kind.get("epoch", [])
+    epoch_walls: dict[int, float] = {}
+    for e in epochs:
+        if e.get("epoch") is not None and e.get("wall_s") is not None:
+            epoch_walls[int(e["epoch"])] = float(e["wall_s"])
+    crash_events = by_kind.get("crashdump", [])
+    crashdump = _read_json(path.parent / CRASHDUMP_FILENAME)
+    if crashdump is None and crash_events:
+        # The dump file may have been reaped; the flushed event survives.
+        crashdump = {"reason": crash_events[-1].get("reason"),
+                     "path": crash_events[-1].get("path")}
+    heartbeat = _read_json(path.parent / HEARTBEAT_FILENAME)
+    try:
+        rel = str(path.parent.relative_to(root))
+    except ValueError:
+        rel = str(path.parent)
+    label = f"p{proc}" if proc is not None else (rel or path.parent.name)
+    return {
+        "stream": rel or ".",
+        "label": label,
+        "proc": proc,
+        "nproc": nproc,
+        "host": host,
+        "pid": pid,
+        "run": events[0].get("run") if events else None,
+        "events": len(events),
+        "started": started,
+        "finished": finished is not None,
+        "diverged": bool(finished and finished.get("diverged")),
+        "steps_per_sec": finished.get("steps_per_sec") if finished else None,
+        "epochs": len(epoch_walls),
+        "last_epoch": max(epoch_walls) if epoch_walls else None,
+        "epoch_walls": epoch_walls,
+        "first_ts": events[0].get("ts") if events else None,
+        "last_ts": events[-1].get("ts") if events else None,
+        "crashdump": None if crashdump is None else {
+            "reason": crashdump.get("reason"),
+            "phase": crashdump.get("phase"),
+            "epoch": crashdump.get("epoch"),
+            "path": str(path.parent / CRASHDUMP_FILENAME),
+        },
+        "heartbeat": None if heartbeat is None else {
+            "ts": heartbeat.get("ts"),
+            "phase": heartbeat.get("phase"),
+            "epoch": heartbeat.get("epoch"),
+            "beats": heartbeat.get("beats"),
+        },
+    }
+
+
+def _last_activity(d: dict) -> float | None:
+    candidates = [d.get("last_ts")]
+    if d.get("heartbeat"):
+        candidates.append(d["heartbeat"].get("ts"))
+    candidates = [c for c in candidates if c is not None]
+    return max(candidates) if candidates else None
+
+
+def _status(d: dict, now: float, grace_s: float) -> str:
+    if d["finished"]:
+        return "finished"
+    crash = d.get("crashdump")
+    if crash and crash.get("reason"):
+        reason = str(crash["reason"])
+        if reason.startswith("signal"):
+            return "killed"
+        if reason.startswith("hang"):
+            return "hung"
+        return "crashed"
+    last = _last_activity(d)
+    if last is not None and (now - last) <= grace_s:
+        return "running"
+    return "dead"
+
+
+def aggregate_streams(
+    digests: list[dict],
+    now: float | None = None,
+    grace_s: float = DEFAULT_GRACE_S,
+) -> dict:
+    """The fleet report over per-process digests (see module docstring)."""
+    now = time.time() if now is None else now
+    for d in digests:
+        d["status"] = _status(d, now, grace_s)
+
+    expected = max(
+        [d["nproc"] for d in digests if d.get("nproc")] or [len(digests)]
+    )
+    present = {d["proc"] for d in digests if d.get("proc") is not None}
+    missing = (
+        sorted(set(range(expected)) - present)
+        if present and expected > len(digests)
+        else []
+    )
+
+    # Skew + wait attribution over the epochs EVERY stream shares — a
+    # process that died at epoch 3 must not make the survivors' epochs
+    # 4..N look like infinite skew.
+    walls = [d["epoch_walls"] for d in digests if d["epoch_walls"]]
+    shared = sorted(set.intersection(*map(set, walls))) if len(walls) > 1 else []
+    per_epoch_skew = {
+        e: max(w[e] for w in walls) - min(w[e] for w in walls)
+        for e in shared
+    }
+    slowest_count: dict[str, int] = {}
+    for e in shared:
+        slowest = max(
+            (d for d in digests if e in d["epoch_walls"]),
+            key=lambda d: d["epoch_walls"][e],
+        )
+        slowest_count[slowest["label"]] = (
+            slowest_count.get(slowest["label"], 0) + 1
+        )
+    collective_wait = {
+        d["label"]: sum(
+            max(w[e] for w in walls) - d["epoch_walls"][e] for e in shared
+        )
+        for d in digests
+        if d["epoch_walls"]
+    }
+
+    straggler = None
+    if shared:
+        totals = {
+            d["label"]: sum(d["epoch_walls"][e] for e in shared)
+            for d in digests
+            if d["epoch_walls"]
+        }
+        worst_label = max(totals, key=totals.get)
+        ordered = sorted(totals.values())
+        median = ordered[len(ordered) // 2]
+        slowdown = (totals[worst_label] / median - 1.0) if median > 0 else 0.0
+        worst = next(d for d in digests if d["label"] == worst_label)
+        straggler = {
+            "label": worst_label,
+            "proc": worst["proc"],
+            "host": worst["host"],
+            "shared_epoch_wall_s": totals[worst_label],
+            "slowdown_pct": 100.0 * slowdown,
+            "slowest_epochs": slowest_count.get(worst_label, 0),
+            "significant": slowdown > STRAGGLER_SLOWDOWN,
+        }
+
+    per_host_wall: dict[str, list[float]] = {}
+    for d in digests:
+        if d["epoch_walls"] and d.get("host"):
+            per_host_wall.setdefault(d["host"], []).extend(
+                d["epoch_walls"][e] for e in (shared or d["epoch_walls"])
+            )
+
+    fleet_last = max(
+        (t for t in (_last_activity(d) for d in digests) if t is not None),
+        default=None,
+    )
+    heartbeat_gaps = {}
+    for d in digests:
+        last = _last_activity(d)
+        if last is not None and fleet_last is not None:
+            heartbeat_gaps[d["label"]] = fleet_last - last
+
+    failures: list[str] = []
+    for d in digests:
+        if d["status"] in ("killed", "hung", "crashed", "dead"):
+            crash = d.get("crashdump") or {}
+            where = (
+                f"epoch {d['last_epoch']}" if d["last_epoch"] is not None
+                else f"phase {crash.get('phase') or '?'}"
+            )
+            detail = crash.get("reason") or (
+                "no crashdump; last activity "
+                f"{heartbeat_gaps.get(d['label'], 0.0):.1f}s behind the fleet"
+            )
+            failures.append(
+                f"{d['label']} (host {d['host']}, pid {d['pid']}) "
+                f"{d['status'].upper()} at {where} — {detail}"
+            )
+        elif d["diverged"]:
+            failures.append(
+                f"{d['label']} diverged (halted on a non-finite loss)"
+            )
+    for proc in missing:
+        failures.append(
+            f"p{proc} left no event stream ({expected} processes expected, "
+            f"{len(digests)} streams found)"
+        )
+    if straggler and straggler["significant"]:
+        failures_note = (
+            f"{straggler['label']} straggles: "
+            f"{straggler['slowdown_pct']:.0f}% over the fleet median, "
+            f"slowest in {straggler['slowest_epochs']}/{len(shared)} epochs"
+        )
+        # A slow-but-finished straggler is a warning, not a failure.
+        if any(d["label"] == straggler["label"]
+               and d["status"] != "finished" for d in digests):
+            failures.append(failures_note)
+
+    return {
+        "processes": digests,
+        "expected_processes": expected,
+        "finished_processes": sum(d["status"] == "finished" for d in digests),
+        "missing_processes": missing,
+        "epoch_skew": {
+            "epochs_compared": len(shared),
+            "mean_s": (
+                sum(per_epoch_skew.values()) / len(per_epoch_skew)
+                if per_epoch_skew
+                else None
+            ),
+            "max_s": max(per_epoch_skew.values()) if per_epoch_skew else None,
+            "max_epoch": (
+                max(per_epoch_skew, key=per_epoch_skew.get)
+                if per_epoch_skew
+                else None
+            ),
+        },
+        "per_host_mean_epoch_wall_s": {
+            h: sum(v) / len(v) for h, v in sorted(per_host_wall.items())
+        },
+        "collective_wait_s": collective_wait,
+        "straggler": straggler,
+        "heartbeat_gaps_s": heartbeat_gaps,
+        "failures": failures,
+        "healthy": not failures,
+    }
+
+
+def aggregate_path(
+    root: str | Path,
+    now: float | None = None,
+    grace_s: float = DEFAULT_GRACE_S,
+) -> dict:
+    root = Path(root)
+    streams = discover_streams(root)
+    if not streams:
+        raise FileNotFoundError(f"no {EVENTS_FILENAME} under {root}")
+    report = aggregate_streams(
+        [digest_stream(p, root) for p in streams], now=now, grace_s=grace_s
+    )
+    report["root"] = str(root)
+    return report
+
+
+def postmortem_path(
+    root: str | Path,
+    now: float | None = None,
+    grace_s: float = DEFAULT_GRACE_S,
+) -> dict:
+    """The fleet report plus the one-line verdict an operator (or a sweep
+    runner's failed-cell row) wants first."""
+    report = aggregate_path(root, now=now, grace_s=grace_s)
+    report["headline"] = _headline(report)
+    report["exit_code"] = 0 if report["healthy"] else 2
+    return report
+
+
+def _headline(report: dict) -> str:
+    n = len(report["processes"])
+    if report["healthy"]:
+        return (
+            f"all {n} process(es) finished; no failures detected"
+        )
+    return report["failures"][0] + (
+        f" [{len(report['failures'])} finding(s); "
+        f"{report['finished_processes']}/{report['expected_processes']} "
+        "finished]"
+    )
+
+
+# ------------------------------------------------------------- rendering
+
+
+def _fmt(value, spec: str = ".3g") -> str:
+    return "n/a" if value is None else format(value, spec)
+
+
+def render_fleet_text(report: dict, postmortem: bool = False) -> str:
+    lines = []
+    if postmortem:
+        lines.append(f"postmortem     : {report['headline']}")
+    lines += [
+        f"fleet          : {len(report['processes'])} stream(s), "
+        f"{report['finished_processes']}/{report['expected_processes']} "
+        "finished",
+    ]
+    for d in report["processes"]:
+        hb = report["heartbeat_gaps_s"].get(d["label"])
+        lines.append(
+            f"  {d['label']:<8s} {d['status']:<9s} host={d['host']} "
+            f"pid={d['pid']} epochs={d['epochs']} "
+            f"last_epoch={_fmt(d['last_epoch'], 'd') if d['last_epoch'] is not None else 'n/a'} "
+            f"sps={_fmt(d['steps_per_sec'], '.2f')} "
+            f"gap={_fmt(hb, '.1f')}s"
+        )
+    skew = report["epoch_skew"]
+    lines.append(
+        f"epoch skew     : mean {_fmt(skew['mean_s'], '.4f')}s | "
+        f"max {_fmt(skew['max_s'], '.4f')}s"
+        + (
+            f" @ epoch {skew['max_epoch']}"
+            if skew["max_epoch"] is not None
+            else ""
+        )
+        + f" ({skew['epochs_compared']} shared epochs)"
+    )
+    for host, wall in report["per_host_mean_epoch_wall_s"].items():
+        lines.append(f"  host {host:<12s} mean epoch wall {wall:.4f}s")
+    if report["collective_wait_s"]:
+        waits = ", ".join(
+            f"{label} {wait:.3f}s"
+            for label, wait in sorted(report["collective_wait_s"].items())
+        )
+        lines.append(f"collective wait: {waits}")
+    s = report["straggler"]
+    if s is not None:
+        lines.append(
+            f"straggler      : {s['label']} (host {s['host']}) "
+            f"+{s['slowdown_pct']:.1f}% vs fleet median, slowest in "
+            f"{s['slowest_epochs']} epoch(s)"
+            + ("" if s["significant"] else " [not significant]")
+        )
+    if report["failures"]:
+        lines.append("FAILURES:")
+        lines.extend(f"  - {f}" for f in report["failures"])
+    else:
+        lines.append("fleet health   : ok")
+    return "\n".join(lines)

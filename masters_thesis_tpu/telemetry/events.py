@@ -1,8 +1,8 @@
 """Structured JSONL event sink — one append-only stream per run.
 
 Each event is one JSON object per line with a fixed envelope
-(``ts``/``kind``/``run``/``seq``/``host``/``pid``/``proc``) and a flat,
-kind-specific payload (schema: docs/telemetry.md). The file is flushed
+(``ts``/``kind``/``run``/``seq``/``host``/``pid``/``proc``/``nproc``) and a
+flat, kind-specific payload (schema: docs/telemetry.md). The file is flushed
 after every line: a SIGKILL mid-run (the grid runner's budget cap, a relay
 wedge watchdog) loses at most the event being written, and a resumed run
 appends to the same stream rather than clobbering it.
@@ -21,17 +21,24 @@ import time
 from pathlib import Path
 
 # Envelope keys; payload keys must not collide (enforced at emit time).
-RESERVED_KEYS = ("ts", "kind", "run", "seq", "host", "pid", "proc")
+RESERVED_KEYS = ("ts", "kind", "run", "seq", "host", "pid", "proc", "nproc")
 
 
 class EventSink:
     """Thread-safe append-only JSONL writer with per-line flush."""
 
-    def __init__(self, path: str | Path, run_id: str, proc: int | None = None):
+    def __init__(
+        self,
+        path: str | Path,
+        run_id: str,
+        proc: int | None = None,
+        nproc: int | None = None,
+    ):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.run_id = run_id
         self.proc = proc
+        self.nproc = nproc
         self._host = socket.gethostname()
         self._pid = os.getpid()
         self._seq = 0
@@ -51,6 +58,7 @@ class EventSink:
                 "host": self._host,
                 "pid": self._pid,
                 "proc": self.proc,
+                "nproc": self.nproc,
                 **payload,
             }
             self._seq += 1

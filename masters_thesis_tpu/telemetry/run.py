@@ -16,6 +16,7 @@ into a measured runtime counter.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from pathlib import Path
@@ -24,20 +25,44 @@ from typing import Callable
 from masters_thesis_tpu.telemetry.events import EventSink
 from masters_thesis_tpu.telemetry.registry import MetricsRegistry
 
+# Fleet identity env fallbacks, in priority order: the standard JAX cluster
+# vars (exported by parallel.mesh.distributed_initialize for child tools),
+# then the in-repo multi-host sweep sharding vars.
+_IDENTITY_ENV = (
+    ("JAX_PROCESS_INDEX", "JAX_PROCESS_COUNT"),
+    ("MT_HOST_INDEX", "MT_NUM_HOSTS"),
+)
 
-def _process_index() -> int | None:
-    """jax.process_index() iff jax is already imported AND initialized-safe.
 
-    Never imports jax: telemetry must stay usable (and hang-free) in
-    host-only tooling.
+def process_identity() -> tuple[int | None, int | None]:
+    """(process_index, process_count) for tagging telemetry streams.
+
+    Prefers a live jax backend iff jax is already imported (never imports
+    it: telemetry must stay usable, and hang-free, in host-only tooling);
+    falls back to the cluster env (``JAX_PROCESS_INDEX``/``MT_HOST_INDEX``)
+    so streams written BEFORE ``jax.distributed`` init — or by jax-free
+    simulated workers — still merge unambiguously in the aggregator.
     """
     jax = sys.modules.get("jax")
-    if jax is None:
-        return None
-    try:
-        return int(jax.process_index())
-    except Exception:  # backend not up yet — identity is optional
-        return None
+    if jax is not None:
+        try:
+            return int(jax.process_index()), int(jax.process_count())
+        except Exception:  # backend not up yet — fall through to env
+            pass
+    for index_key, count_key in _IDENTITY_ENV:
+        index = os.environ.get(index_key)
+        if index is None:
+            continue
+        try:
+            count = os.environ.get(count_key)
+            return int(index), (int(count) if count else None)
+        except ValueError:  # malformed env is not identity
+            continue
+    return None, None
+
+
+def _process_index() -> int | None:
+    return process_identity()[0]
 
 
 class TelemetryRun:
@@ -56,23 +81,48 @@ class TelemetryRun:
     ):
         self.run_dir = Path(run_dir)
         self.run_dir.mkdir(parents=True, exist_ok=True)
-        proc = _process_index()
+        proc, nproc = process_identity()
         if run_id is None:
             run_id = time.strftime("%Y%m%d-%H%M%S") + f"-p{proc or 0}"
         self.run_id = run_id
-        self.registry = MetricsRegistry(
-            tags={} if proc is None else {"process_index": proc}
-        )
+        tags = {}
+        if proc is not None:
+            tags["process_index"] = proc
+        if nproc is not None:
+            tags["process_count"] = nproc
+        self.registry = MetricsRegistry(tags=tags)
         self.sink = EventSink(
-            self.run_dir / "events.jsonl", run_id=run_id, proc=proc
+            self.run_dir / "events.jsonl", run_id=run_id, proc=proc,
+            nproc=nproc,
         )
+        # Optional flight recorder (attach_flight_recorder): every emitted
+        # event is mirrored into its bounded ring so a crashdump carries the
+        # run's recent history without re-reading the stream.
+        self.recorder = None
         if meta:
             self.event("run_meta", meta=meta)
 
     # ------------------------------------------------------------- emitters
 
     def event(self, kind: str, **payload) -> dict:
-        return self.sink.emit(kind, **payload)
+        ev = self.sink.emit(kind, **payload)
+        if self.recorder is not None:
+            self.recorder.record(ev)
+        return ev
+
+    def attach_flight_recorder(self, **kwargs):
+        """Attach (or return the already-attached) in-process flight
+        recorder for this run: crashdump.json on SIGTERM/SIGQUIT/hang,
+        heartbeat.json for the fleet aggregator. Idempotent — the first
+        attach wins, so a Trainer sharing a caller-owned TelemetryRun does
+        not stack recorders."""
+        if self.recorder is None:
+            from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
+
+            self.recorder = FlightRecorder(
+                self.run_dir, run_id=self.run_id, sink=self.sink, **kwargs
+            )
+        return self.recorder
 
     def counter(self, name: str):
         return self.registry.counter(name)
@@ -103,6 +153,8 @@ class TelemetryRun:
         return snap
 
     def close(self) -> None:
+        if self.recorder is not None:
+            self.recorder.close()
         self.sink.close()
 
 

@@ -36,6 +36,7 @@ from masters_thesis_tpu.models.objectives import ModelSpec
 from masters_thesis_tpu.parallel import (
     DATA_AXIS,
     batch_sharding,
+    distributed_run_context,
     global_put,
     make_data_mesh,
 )
@@ -100,6 +101,7 @@ class Trainer:
         resume: bool = False,
         preflight: bool = False,
         telemetry: TelemetryRun | str | Path | None = None,
+        hang_timeout_s: float | None = None,
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
@@ -145,6 +147,12 @@ class Trainer:
         if isinstance(telemetry, (str, Path)):
             telemetry = TelemetryRun(telemetry)
         self.telemetry = telemetry
+        # Flight-recorder hang watchdog: with telemetry on, a run that makes
+        # no progress for hang_timeout_s dumps crashdump.json (all-thread
+        # stacks + recent events) instead of wedging silently. None keeps
+        # heartbeats and signal dumps but no hang detection (the default —
+        # a legitimate giant compile must not be declared a hang).
+        self.hang_timeout_s = hang_timeout_s
 
     def _resolve_dtype(self, spec, dm):
         """Concrete compute dtype for this (model, window) shape.
@@ -427,8 +435,15 @@ class Trainer:
         # Compile events are measured, not inferred: cache-miss deltas on
         # the hot program (scan epoch / stream step) and on eval_fn turn
         # tracelint's TA201 "compiles exactly once" into a runtime counter.
-        epoch_tracker = eval_tracker = rec = None
+        epoch_tracker = eval_tracker = rec = flight = None
         if tel:
+            # Attach the flight recorder BEFORE the first event so the ring
+            # buffer holds the whole run and SIGTERM/hang forensics cover the
+            # compile phase (where multi-host runs most often wedge).
+            flight = tel.attach_flight_recorder(
+                hang_timeout_s=self.hang_timeout_s
+            )
+            flight.beat(phase="setup")
             tel.event(
                 "run_started",
                 platform=jax.default_backend(),
@@ -441,6 +456,7 @@ class Trainer:
                 objective=spec.objective,
                 trainer=self.name,
                 seed=self.seed,
+                distributed=distributed_run_context(),
             )
             epoch_tracker = CompileTracker(hot_fn, size_fn=jit_cache_size)
             eval_tracker = CompileTracker(eval_fn, size_fn=jit_cache_size)
@@ -497,6 +513,13 @@ class Trainer:
             row.update(
                 {f"loss/{k}/train": v for k, v in train_metrics.items()}
             )
+            if flight is not None:
+                # Divergence context for crashdumps: the recent loss/lr
+                # history shows WHETHER the run was blowing up when it died.
+                flight.track_scalar(
+                    "loss/total/train", row.get("loss/total/train")
+                )
+                flight.track_scalar("lr", row.get("lr-Adam"))
             return not np.isfinite(row.get("loss/total/train", 0.0))
 
         def emit(row) -> None:
@@ -535,6 +558,10 @@ class Trainer:
 
         for epoch in range(start_epoch, self.max_epochs):
             prof.maybe_start(epoch)
+            if flight is not None:
+                # Progress marker for the hang watchdog (host memory only —
+                # no fence, no I/O; tracelint's hot-loop contract holds).
+                flight.beat(phase="train", epoch=epoch)
             if rec:
                 # Closes the previous unfenced epoch boundary-to-boundary
                 # (the async-dispatch-aware accounting in telemetry/run.py)
@@ -552,10 +579,14 @@ class Trainer:
 
             if rec:
                 stats = epoch_stats["cur"]
+                compiles = epoch_tracker.poll()
                 rec.dispatched(
-                    compiles=epoch_tracker.poll(),
+                    compiles=compiles,
                     data_wait_s=stats.get_wait_s if stats else 0.0,
                 )
+                if flight is not None and compiles:
+                    flight.note(epoch_compiles=epoch_tracker.total,
+                                last_compile_epoch=epoch)
                 if stats:
                     tel.counter("data/batches").inc(stats.gets)
                     tel.gauge("data/prefetch_mean_depth").set(stats.mean_depth)
@@ -654,6 +685,8 @@ class Trainer:
                        best_val, dm, scheduler, best_val)
 
         if tel:
+            if flight is not None:
+                flight.beat(phase="finished")
             tel.sample_memory(None)
             tel.event(
                 "run_finished",

@@ -37,6 +37,7 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
+import os
 import subprocess
 import sys
 import time
@@ -53,6 +54,28 @@ PER_CELL_CAP_S = 3 * 3600
 
 def log(msg: str) -> None:
     print(f"{datetime.datetime.now():%H:%M:%S} {msg}", flush=True)
+
+
+def cell_heartbeat(cell: str, phase: str, **extra) -> None:
+    """Atomic per-cell heartbeat under results/heartbeats/<cell>.json.
+
+    The runner's own last-sign-of-life channel: when the whole runner is
+    SIGKILLed (budget cap, environment reset) the log just stops, but the
+    heartbeat file shows which cell was in flight and in which phase —
+    the same role heartbeat.json plays for a training process. Best-effort:
+    a full disk must not take the sweep down."""
+    hb_dir = RESULTS_DIR / "heartbeats"
+    try:
+        hb_dir.mkdir(parents=True, exist_ok=True)
+        path = hb_dir / f"{cell}.json"
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(
+            {"cell": cell, "phase": phase, "ts": time.time(),
+             "pid": os.getpid(), **extra}
+        ))
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 def tpu_ready() -> bool:
@@ -257,14 +280,23 @@ def run_cell(
         return
 
     log(f"train {cell}")
+    cell_heartbeat(cell, "train", budget_s=round(budget, 1))
     t0 = time.time()
     completed, truncated = train_with_retry(
         cell, train_overrides, budget, deadline
     )
     if not completed and not truncated:
-        return  # hard failure, already logged
+        # Hard failure, already logged — attach the fleet verdict the way
+        # telemetry_summary headlines successful cells: which process died
+        # or hung, and where (jax-free, so this can't hang on the backend).
+        post = postmortem_headline(ckpt)
+        if post is not None:
+            log(f"{cell}: postmortem: {post['headline']}")
+        cell_heartbeat(cell, "failed", postmortem=post)
+        return
     if truncated:
         log(f"{cell}: evaluating the last checkpoint")
+    cell_heartbeat(cell, "eval", truncated=truncated)
     if completed and ckpt.exists():
         # Record completion for ensure_checkpoint: a cell run_cell finished
         # is exactly as confirmed as one ensure_checkpoint finished, and
@@ -274,6 +306,10 @@ def run_cell(
 
     if not ckpt.exists():
         log(f"{cell}: no checkpoint at {ckpt}; nothing to record")
+        post = postmortem_headline(ckpt)
+        if post is not None:
+            log(f"{cell}: postmortem: {post['headline']}")
+        cell_heartbeat(cell, "failed", postmortem=post)
         return
     try:
         ev = subprocess.run(
@@ -288,14 +324,21 @@ def run_cell(
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
         err = getattr(exc, "stderr", "") or ""
         log(f"{cell}: eval failed ({type(exc).__name__})\n{err[-1500:]}")
+        cell_heartbeat(cell, "failed", stage="eval")
         return
     row = json.loads(ev.stdout.strip().splitlines()[-1])
     row.update({"cell": cell, "train_wall_s": round(wall, 1),
                 "truncated": truncated,
                 "telemetry": telemetry_summary(ckpt)})
+    if truncated:
+        # A truncated cell is a partial failure: record WHY training was
+        # cut short (hang? killed? straggler?) next to its metrics.
+        row["postmortem"] = postmortem_headline(ckpt)
     RESULTS_DIR.mkdir(exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
+    cell_heartbeat(cell, "done", truncated=truncated,
+                   wall_s=round(wall, 1))
     log(f"{cell}: recorded (wall {wall:.0f}s, truncated={truncated})")
 
 
@@ -331,6 +374,36 @@ def telemetry_summary(ckpt: Path) -> dict | None:
         "data_wait_s": report.get("data", {}).get("data_wait_s"),
         "peak_bytes": report.get("memory", {}).get("peak_bytes"),
         "violations": report.get("violations"),
+    }
+
+
+def postmortem_headline(ckpt: Path) -> dict | None:
+    """Fleet verdict on a failed/truncated cell, from its telemetry dir.
+
+    Mirrors telemetry_summary for the failure path: the postmortem CLI is
+    jax-free by contract (it must work exactly when the backend is wedged),
+    so this never hangs on the relay. Returns the one-line verdict plus the
+    finding list — or None when the run left no stream to read."""
+    tel_dir = ckpt.parent.parent / "telemetry"
+    if not (tel_dir / "events.jsonl").exists():
+        return None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+             "postmortem", str(tel_dir), "--json"],
+            cwd=REPO,
+            timeout=120,
+            capture_output=True,
+            text=True,
+        )
+        report = json.loads(out.stdout)
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError) as exc:
+        log(f"postmortem failed for {tel_dir}: {type(exc).__name__}")
+        return None
+    return {
+        "headline": report.get("headline"),
+        "exit_code": out.returncode,
+        "failures": report.get("failures"),
     }
 
 

@@ -6,6 +6,18 @@
 # should never fire in a healthy run.
 cd /root/repo || exit 1
 
+# Queue-level heartbeat: the queue runs unattended and a SIGKILL (driver
+# budget cap) leaves no log tail — the heartbeat file shows which stage
+# was in flight, same protocol as the trainer's heartbeat.json. Atomic
+# via mv so readers never see a torn file.
+HB=results/heartbeats/tpu_queue.json
+beat() {
+  mkdir -p results/heartbeats
+  printf '{"stage": "%s", "ts": %s, "pid": %d}\n' \
+    "$1" "$(date -u +%s)" "$$" > "$HB.tmp" && mv "$HB.tmp" "$HB"
+}
+beat "starting"
+
 # Own the pause: create it if absent, and on ANY exit remove it only if WE
 # created it (an operator's pre-existing PAUSE is theirs to lift). A
 # pending BENCH_REQUEST is left alone on early death — it is only consumed
@@ -17,6 +29,7 @@ if [ ! -f results/PAUSE ]; then
 fi
 trap '[ "$CREATED_PAUSE" = 1 ] && rm -f results/PAUSE' EXIT
 
+beat "waiting_relay"
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     break
@@ -29,6 +42,7 @@ echo "$(date -u +%H:%M:%S) relay healthy"
 # PAUSE only stops the runner from LAUNCHING new cells; an in-flight
 # train.py cell owns the chip until it finishes. Concurrent use crashes it
 # (documented failure mode) — wait it out.
+beat "waiting_cell"
 while pgrep -f "python train.py" > /dev/null 2>&1; do
   echo "$(date -u +%H:%M:%S) grid cell in flight; waiting 120s"
   sleep 120
@@ -36,20 +50,40 @@ done
 echo "$(date -u +%H:%M:%S) chip free; starting TPU queue"
 
 echo "== stack kernel Mosaic check =="
+beat "stack_kernel_check"
 timeout 900 python sweeps/check_stack_tpu.py 2>&1
 
 echo "== fresh bench capture =="
-timeout 2700 python bench.py > results/bench_r4_tpu.json 2> results/bench_r4_tpu.log
+beat "bench"
+# --telemetry-dir makes every watchdogged point write its events.jsonl +
+# flight-recorder files under one root, so a failed capture has something
+# for the postmortem below to read.
+BENCH_TEL=results/bench_r4_telemetry
+timeout 2700 python bench.py --telemetry-dir "$BENCH_TEL" \
+  > results/bench_r4_tpu.json 2> results/bench_r4_tpu.log
+BENCH_RC=$?
 tail -c 400 results/bench_r4_tpu.json
+if [ "$BENCH_RC" -ne 0 ] || ! [ -s results/bench_r4_tpu.json ]; then
+  # No JSON line (hang/SIGKILL) or nonzero exit: reconstruct what died
+  # from the per-point streams. The postmortem CLI is jax-free by
+  # contract, so it works exactly when the chip is wedged.
+  echo "== bench failed (rc=$BENCH_RC); postmortem =="
+  beat "bench_postmortem"
+  timeout 300 python -m masters_thesis_tpu.telemetry postmortem \
+    "$BENCH_TEL" 2>&1 | tee -a results/bench_r4_tpu.log
+fi
 
 echo "== wavefront A/B sweep =="
+beat "fused_pair_sweep"
 timeout 4500 python sweeps/bench_fused_pair.py 2>&1 | tee results/bench_fused_r4.log
 
 echo "== profile breakdown =="
+beat "profile_breakdown"
 timeout 1800 python sweeps/profile_breakdown.py 2>&1 | tee results/profile_r4.log
 
 # Queue complete: the opportunistic-bench request is satisfied by the
 # capture above, and the chip goes back to the grid.
 rm -f results/BENCH_REQUEST results/PAUSE
 CREATED_PAUSE=0
+beat "done"
 echo "$(date -u +%H:%M:%S) TPU queue done; grid unpaused"

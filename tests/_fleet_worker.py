@@ -1,0 +1,99 @@
+"""Simulated fleet process for flight-recorder / postmortem tests.
+
+Not a pytest module (no ``test_`` prefix): tests/test_flightrec.py spawns
+2 of these as a simulated multi-host run — jax-free, so the scenarios
+(SIGTERM a victim, hard-hang one past the heartbeat deadline) exercise
+exactly the forensic path that must work when the backend is wedged.
+
+Usage: python tests/_fleet_worker.py <root> <rank> <world> <scenario>
+
+Writes its stream to ``<root>/p<rank>/`` with identity from the
+``JAX_PROCESS_INDEX``/``JAX_PROCESS_COUNT`` env fallback (set here, the
+same vars ``parallel.mesh.distributed_initialize`` exports on real pods).
+
+Scenarios:
+
+- ``healthy``        — 3 quick epochs, run_finished, clean close.
+- ``victim-sigterm`` — emits 2 epochs then sleeps forever; the test sends
+  SIGTERM and the recorder's handler dumps crashdump.json on the way down.
+- ``victim-hang``    — emits 2 epochs then stops beating with a ~0.5s hang
+  timeout; the watchdog thread dumps, then the worker prints the dump path
+  and idles until the test kills it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+
+def main() -> None:
+    root, rank, world, scenario = (
+        Path(sys.argv[1]),
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    os.environ["JAX_PROCESS_INDEX"] = str(rank)
+    os.environ["JAX_PROCESS_COUNT"] = str(world)
+
+    from masters_thesis_tpu.telemetry import TelemetryRun
+
+    tel = TelemetryRun(root / f"p{rank}", run_id=f"fleet-p{rank}")
+    rec = tel.attach_flight_recorder(
+        heartbeat_interval_s=0.1,
+        hang_timeout_s=0.5 if scenario == "victim-hang" else None,
+    )
+    rec.beat(phase="setup")
+    tel.event(
+        "run_started", platform="sim", n_devices=1, strategy="fleet-sim",
+        epoch_mode="scan", steps_per_epoch=4, max_epochs=3, start_epoch=0,
+        objective="mse", trainer="fleet", seed=0,
+    )
+    epochs = 3 if scenario == "healthy" else 2
+    for epoch in range(epochs):
+        rec.beat(phase="train", epoch=epoch)
+        rec.track_scalar("loss/total/train", 1.0 / (epoch + 1))
+        # Rank-skewed walls so the aggregator has real skew to report.
+        wall = 0.05 * (1 + rank) if scenario == "healthy" else 0.05
+        tel.event(
+            "epoch", epoch=epoch, steps=4, wall_s=wall, dispatch_s=0.001,
+            device_s=None, data_wait_s=0.0, compile_events=0,
+            compiled=False, fenced=False, steps_per_sec=4.0 / wall,
+        )
+
+    if scenario == "healthy":
+        tel.event(
+            "run_finished", epochs=epochs, total_steps=4 * epochs,
+            steps_per_sec=40.0, diverged=False, best_val=0.5,
+            epoch_compiles=1, eval_compiles=0,
+        )
+        tel.close()
+        print("done", flush=True)
+        return
+
+    # Both victim scenarios: signal readiness, then stop making progress.
+    print("ready", flush=True)
+    if scenario == "victim-sigterm":
+        # The SIGTERM handler dumps and re-delivers; this sleep never ends
+        # from the worker's side.
+        while True:
+            time.sleep(0.5)
+    if scenario == "victim-hang":
+        # No more beats: the watchdog thread must fire within ~0.5s and
+        # dump. Wait for the dump, report it, then idle for the kill.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if rec.crashdump_path.exists():
+                print("dumped", flush=True)
+                break
+            time.sleep(0.1)
+        while True:
+            time.sleep(0.5)
+    raise SystemExit(f"unknown scenario: {scenario}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,6 @@ section, never the line."""
 import importlib.util
 import json
 import subprocess
-import types
 from pathlib import Path
 
 import pytest
@@ -27,49 +26,113 @@ def bench():
     return mod
 
 
+class FakePopen:
+    """Stand-in for the watchdog child: scripted communicate() behaviour.
+
+    ``hang_until_kill=False`` hangs the first communicate (the watchdog
+    timeout) but exits within the SIGTERM grace; ``True`` only dies at
+    SIGKILL — distinguishing a child whose flight recorder dumped from one
+    too wedged to die.
+    """
+
+    def __init__(self, returncode=0, stdout="", stderr="",
+                 hang=False, hang_until_kill=False):
+        self.returncode = returncode
+        self._stdout = stdout
+        self._stderr = stderr
+        self._hang = hang
+        self._hang_until_kill = hang_until_kill
+        self.terminated = False
+        self.killed = False
+
+    def communicate(self, timeout=None):
+        if self._hang and not self.terminated and not self.killed:
+            raise subprocess.TimeoutExpired("bench --point", timeout)
+        if self._hang_until_kill and not self.killed:
+            raise subprocess.TimeoutExpired("bench --point", timeout)
+        return self._stdout, self._stderr
+
+    def terminate(self):
+        self.terminated = True
+        self.returncode = -15
+
+    def kill(self):
+        self.killed = True
+        self.returncode = -9
+
+
+def _patch_popen(monkeypatch, bench, proc: FakePopen) -> None:
+    def fake_popen(cmd, **kwargs):
+        assert "--point" in cmd
+        return proc
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+
+
 def test_measure_point_returns_payload(bench, monkeypatch):
     payload = {"steps_per_sec": 123.4, "platform": "tpu",
                "windows_per_epoch": 777}
-
-    def fake_run(cmd, **kwargs):
-        assert "--point" in cmd
-        return types.SimpleNamespace(
-            returncode=0, stdout=json.dumps(payload) + "\n", stderr=""
-        )
-
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    _patch_popen(
+        monkeypatch, bench, FakePopen(stdout=json.dumps(payload) + "\n")
+    )
     assert bench._measure_point("mse", 1, 8, 60.0) == payload
 
 
-def test_measure_point_none_on_hang(bench, monkeypatch, capsys):
-    def hang(cmd, **kwargs):
-        raise subprocess.TimeoutExpired(cmd, kwargs.get("timeout"))
-
-    monkeypatch.setattr(bench.subprocess, "run", hang)
-    assert bench._measure_point("mse", 1, 8, 60.0) is None
+def test_measure_point_sigterm_grace_on_hang(bench, monkeypatch, capsys):
+    # A hung child is SIGTERMed first (the flight recorder's chance to
+    # dump crashdump.json) and reported as a failure record with its
+    # output tail — not silently dropped.
+    proc = FakePopen(stdout="", stderr="stuck in dispatch", hang=True)
+    _patch_popen(monkeypatch, bench, proc)
+    record = bench._measure_point("mse", 1, 8, 60.0)
+    assert proc.terminated and not proc.killed
+    assert record["failed"] and "hung" in record["reason"]
+    assert "stuck in dispatch" in record["tail"]
+    assert not bench._point_ok(record)
     assert "wedge" in capsys.readouterr().err
 
 
-def test_measure_point_none_on_crash(bench, monkeypatch, capsys):
-    monkeypatch.setattr(
-        bench.subprocess, "run",
-        lambda cmd, **k: types.SimpleNamespace(
-            returncode=1, stdout="", stderr="boom"
-        ),
+def test_measure_point_sigkill_after_grace(bench, monkeypatch):
+    # Too wedged to die on SIGTERM: escalate to SIGKILL, still return a
+    # failure record.
+    proc = FakePopen(hang=True, hang_until_kill=True)
+    _patch_popen(monkeypatch, bench, proc)
+    record = bench._measure_point("mse", 1, 8, 60.0)
+    assert proc.terminated and proc.killed
+    assert record["failed"] and "hung" in record["reason"]
+
+
+def test_measure_point_failure_record_on_crash(bench, monkeypatch, capsys):
+    _patch_popen(
+        monkeypatch, bench, FakePopen(returncode=1, stderr="boom")
     )
-    assert bench._measure_point("nll", 1, 4, 60.0) is None
+    record = bench._measure_point("nll", 1, 4, 60.0)
+    assert record["failed"] and record["reason"] == "crashed"
+    assert record["rc"] == 1 and "boom" in record["tail"]
     assert "boom" in capsys.readouterr().err
 
 
-def test_measure_point_none_on_garbage_stdout(bench, monkeypatch, capsys):
-    monkeypatch.setattr(
-        bench.subprocess, "run",
-        lambda cmd, **k: types.SimpleNamespace(
-            returncode=0, stdout="not json", stderr=""
-        ),
-    )
-    assert bench._measure_point("mse", 8, 4, 60.0) is None
+def test_measure_point_failure_record_on_garbage_stdout(
+    bench, monkeypatch, capsys
+):
+    _patch_popen(monkeypatch, bench, FakePopen(stdout="not json"))
+    record = bench._measure_point("mse", 8, 4, 60.0)
+    assert record["failed"] and "no JSON" in record["reason"]
     assert "no JSON" in capsys.readouterr().err
+
+
+def test_failure_record_carries_crashdump_path(bench, monkeypatch, tmp_path):
+    # When the SIGTERMed child's flight recorder got a dump out, the
+    # failure record points at it (the postmortem entry point).
+    monkeypatch.setenv("MTT_TELEMETRY_DIR", str(tmp_path))
+    crash_dir = tmp_path / "point_mse_bs1"
+    crash_dir.mkdir(parents=True)
+    (crash_dir / "crashdump.json").write_text("{}")
+    _patch_popen(
+        monkeypatch, bench, FakePopen(returncode=-15, hang=True)
+    )
+    record = bench._measure_point("mse", 1, 8, 60.0)
+    assert record["crashdump"] == str(crash_dir / "crashdump.json")
 
 
 def _tpu_line(value: float) -> str:

@@ -1,0 +1,366 @@
+"""Flight recorder + fleet aggregation/postmortem tests.
+
+The subprocess scenarios simulate a 2-process multi-host run (jax-free
+workers — tests/_fleet_worker.py) and kill/hang one process the way real
+fleets die: SIGTERM from a watchdog, and a silent hang past the heartbeat
+deadline. The postmortem CLI must then name the dead/straggler process and
+exit 2 — without importing jax (that's the whole point: it runs when the
+backend is wedged)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from masters_thesis_tpu.telemetry.__main__ import main as cli_main
+from masters_thesis_tpu.telemetry.aggregate import (
+    aggregate_path,
+    postmortem_path,
+)
+from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
+from masters_thesis_tpu.telemetry.run import TelemetryRun, process_identity
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_WORKER = _REPO_ROOT / "tests" / "_fleet_worker.py"
+
+
+# ---------------------------------------------------------------- recorder
+
+
+def _quiet_recorder(tmp_path, **kwargs):
+    """A recorder safe inside pytest: no signal handlers (pytest owns the
+    main thread's handlers), no global faulthandler takeover."""
+    kwargs.setdefault("install_signal_handlers", False)
+    kwargs.setdefault("enable_faulthandler", False)
+    kwargs.setdefault("heartbeat_interval_s", 60.0)
+    return FlightRecorder(tmp_path, **kwargs)
+
+
+def test_ring_buffer_is_bounded(tmp_path):
+    rec = _quiet_recorder(tmp_path, ring_size=8)
+    for i in range(100):
+        rec.record({"kind": "epoch", "epoch": i})
+    rec.dump("test")
+    rec.close()
+    dump = json.loads((tmp_path / "crashdump.json").read_text())
+    ring = dump["ring"]
+    assert len(ring) == 8
+    assert [e["epoch"] for e in ring] == list(range(92, 100))
+    # The last-known-state mirror survives ring eviction.
+    assert dump["state"]["last_epoch"]["epoch"] == 99
+
+
+def test_dump_carries_stacks_state_and_scalars(tmp_path):
+    rec = _quiet_recorder(tmp_path, scalar_history=4)
+    rec.beat(phase="train", epoch=7)
+    rec.note(step=123, compile_count=1)
+    for i in range(10):
+        rec.track_scalar("loss/total/train", float(i))
+    path = rec.dump("signal:SIGTERM (test)")
+    rec.close()
+    dump = json.loads(path.read_text())
+    assert dump["reason"] == "signal:SIGTERM (test)"
+    assert dump["phase"] == "train" and dump["epoch"] == 7
+    assert dump["state"]["step"] == 123
+    # Bounded divergence context: only the newest scalar_history values.
+    assert dump["scalars"]["loss/total/train"] == [6.0, 7.0, 8.0, 9.0]
+    # All-thread stacks include the frame that called dump() — this test.
+    flat = "\n".join(
+        line for t in dump["threads"] for line in t["stack"]
+    )
+    assert "test_dump_carries_stacks_state_and_scalars" in flat
+
+
+def test_first_dump_per_reason_wins(tmp_path):
+    rec = _quiet_recorder(tmp_path)
+    rec.note(marker="first")
+    rec.dump("hang: test")
+    rec.note(marker="second")
+    rec.dump("hang: test")  # same reason: must not overwrite
+    dump = json.loads(rec.crashdump_path.read_text())
+    assert dump["state"]["marker"] == "first"
+    rec.dump("signal:SIGTERM")  # new reason: overwrites
+    dump = json.loads(rec.crashdump_path.read_text())
+    assert dump["state"]["marker"] == "second"
+    rec.close()
+
+
+def test_hang_watchdog_dumps_without_progress(tmp_path):
+    rec = _quiet_recorder(
+        tmp_path, heartbeat_interval_s=0.05, hang_timeout_s=0.2
+    )
+    rec.beat(phase="train", epoch=0)
+    deadline = time.monotonic() + 10.0
+    while not rec.crashdump_path.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    rec.close()
+    assert rec.crashdump_path.exists(), "hang watchdog never dumped"
+    dump = json.loads(rec.crashdump_path.read_text())
+    assert dump["reason"].startswith("hang")
+    assert dump["phase"] == "train"
+
+
+def test_beats_reset_the_hang_latch(tmp_path):
+    rec = _quiet_recorder(
+        tmp_path, heartbeat_interval_s=0.05, hang_timeout_s=0.4
+    )
+    for _ in range(8):  # keep beating faster than the timeout
+        rec.beat(phase="train")
+        time.sleep(0.1)
+    rec.close()
+    assert not rec.crashdump_path.exists()
+
+
+def test_heartbeat_file_tracks_phase(tmp_path):
+    rec = _quiet_recorder(tmp_path)
+    rec.beat(phase="train", epoch=3)
+    rec.close()  # close writes the final heartbeat synchronously
+    hb = json.loads((tmp_path / "heartbeat.json").read_text())
+    assert hb["closed"] is True and hb["phase"] == "closed"
+    assert hb["epoch"] == 3 and hb["beats"] == 1
+
+
+# ----------------------------------------------------- identity + envelope
+
+
+def test_process_identity_env_fallback(monkeypatch):
+    monkeypatch.setitem(sys.modules, "jax", None)  # jax "not imported"
+    monkeypatch.setenv("JAX_PROCESS_INDEX", "3")
+    monkeypatch.setenv("JAX_PROCESS_COUNT", "8")
+    assert process_identity() == (3, 8)
+    monkeypatch.delenv("JAX_PROCESS_COUNT")
+    assert process_identity() == (3, None)
+    monkeypatch.delenv("JAX_PROCESS_INDEX")
+    monkeypatch.setenv("MT_HOST_INDEX", "1")
+    monkeypatch.setenv("MT_NUM_HOSTS", "4")
+    assert process_identity() == (1, 4)
+
+
+def test_events_carry_identity_before_distributed_init(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setitem(sys.modules, "jax", None)
+    monkeypatch.setenv("JAX_PROCESS_INDEX", "2")
+    monkeypatch.setenv("JAX_PROCESS_COUNT", "4")
+    tel = TelemetryRun(tmp_path, run_id="ident")
+    ev = tel.event("run_started")
+    tel.close()
+    assert ev["proc"] == 2 and ev["nproc"] == 4
+    assert tel.registry.tags["process_index"] == 2
+    assert tel.registry.tags["process_count"] == 4
+
+
+# ------------------------------------------------------------- aggregation
+
+
+def _write_sim_stream(
+    root: Path, rank: int, world: int, monkeypatch, epochs=3,
+    finish=True, wall=0.1, wall_by_epoch=None,
+) -> TelemetryRun:
+    monkeypatch.setitem(sys.modules, "jax", None)
+    monkeypatch.setenv("JAX_PROCESS_INDEX", str(rank))
+    monkeypatch.setenv("JAX_PROCESS_COUNT", str(world))
+    tel = TelemetryRun(root / f"p{rank}", run_id=f"sim-p{rank}")
+    tel.event("run_started", platform="sim", n_devices=1,
+              strategy="sim", epoch_mode="scan", steps_per_epoch=4)
+    for epoch in range(epochs):
+        w = wall_by_epoch[epoch] if wall_by_epoch else wall
+        tel.event("epoch", epoch=epoch, steps=4, wall_s=w,
+                  steps_per_sec=4.0 / w)
+    if finish:
+        tel.event("run_finished", epochs=epochs, total_steps=4 * epochs,
+                  steps_per_sec=40.0, diverged=False, best_val=0.1,
+                  epoch_compiles=1, eval_compiles=0)
+    tel.close()
+    return tel
+
+
+def test_aggregate_healthy_fleet(tmp_path, monkeypatch):
+    _write_sim_stream(tmp_path, 0, 2, monkeypatch, wall=0.10)
+    _write_sim_stream(tmp_path, 1, 2, monkeypatch, wall=0.15)
+    report = aggregate_path(tmp_path)
+    assert report["healthy"] and not report["failures"]
+    assert report["expected_processes"] == 2
+    assert report["finished_processes"] == 2
+    skew = report["epoch_skew"]
+    assert skew["epochs_compared"] == 3
+    assert skew["max_s"] == pytest.approx(0.05)
+    # Wait attribution: p0 idles in the collective while p1 finishes.
+    assert report["collective_wait_s"]["p0"] == pytest.approx(0.15)
+    assert report["collective_wait_s"]["p1"] == pytest.approx(0.0)
+    # p1 is the straggler, but below the significance bar it is not a
+    # failure (both finished).
+    assert report["straggler"]["label"] == "p1"
+
+
+def test_postmortem_missing_process_stream(tmp_path, monkeypatch):
+    # nproc says 2, only p0 wrote a stream: the SIGKILL-before-first-event
+    # case. The fleet is incomplete -> exit 2, and the failure says so.
+    _write_sim_stream(tmp_path, 0, 2, monkeypatch)
+    report = postmortem_path(tmp_path)
+    assert report["exit_code"] == 2
+    assert report["missing_processes"] == [1]
+    assert any("p1" in f and "no event stream" in f
+               for f in report["failures"])
+
+
+def test_postmortem_dead_process_heartbeat_gap(tmp_path, monkeypatch):
+    # p1 started, never finished, no crashdump (SIGKILL) and its last
+    # activity is far behind the fleet: status 'dead', exit 2.
+    _write_sim_stream(tmp_path, 0, 2, monkeypatch)
+    _write_sim_stream(tmp_path, 1, 2, monkeypatch, epochs=1, finish=False)
+    report = postmortem_path(
+        tmp_path, now=time.time() + 3600.0, grace_s=30.0
+    )
+    assert report["exit_code"] == 2
+    statuses = {d["label"]: d["status"] for d in report["processes"]}
+    assert statuses == {"p0": "finished", "p1": "dead"}
+    assert "p1" in report["headline"]
+
+
+def test_postmortem_significant_straggler_not_finished(
+    tmp_path, monkeypatch
+):
+    _write_sim_stream(tmp_path, 0, 3, monkeypatch, wall=0.10)
+    _write_sim_stream(tmp_path, 1, 3, monkeypatch, wall=0.10)
+    _write_sim_stream(tmp_path, 2, 3, monkeypatch, wall=0.50, finish=False)
+    report = postmortem_path(tmp_path, now=time.time() + 3600.0)
+    assert report["exit_code"] == 2
+    s = report["straggler"]
+    assert s["label"] == "p2" and s["significant"]
+    assert any("straggles" in f for f in report["failures"])
+
+
+def test_aggregate_cli_exit_codes(tmp_path, monkeypatch, capsys):
+    _write_sim_stream(tmp_path, 0, 1, monkeypatch, epochs=2)
+    assert cli_main(["aggregate", str(tmp_path)]) == 0
+    assert "finished" in capsys.readouterr().out
+    assert cli_main(["aggregate", str(tmp_path / "nope")]) == 1
+    assert cli_main(["postmortem", str(tmp_path), "--json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out)["healthy"] is True
+
+
+# --------------------------------------------------- subprocess scenarios
+
+
+def _spawn(root: Path, rank: int, scenario: str) -> subprocess.Popen:
+    env = {**os.environ, "PYTHONPATH": str(_REPO_ROOT)}
+    return subprocess.Popen(
+        [sys.executable, str(_WORKER), str(root), str(rank), "2", scenario],
+        cwd=_REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _wait_line(proc: subprocess.Popen, want: str):
+    # readline returns "" at EOF (worker died before printing): the assert
+    # then fails with the actual output instead of hanging the test.
+    line = proc.stdout.readline().strip()
+    assert line == want, f"worker said {line!r}, wanted {want!r}"
+
+
+def test_sigterm_leaves_crashdump_and_postmortem_names_victim(tmp_path):
+    p0 = _spawn(tmp_path, 0, "healthy")
+    p1 = _spawn(tmp_path, 1, "victim-sigterm")
+    try:
+        _wait_line(p1, "ready")
+        p1.send_signal(signal.SIGTERM)
+        rc1 = p1.wait(timeout=30)
+        assert p0.wait(timeout=30) == 0
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    # The handler re-delivers SIGTERM after dumping: correct wait status.
+    assert rc1 == -signal.SIGTERM
+    dump = json.loads((tmp_path / "p1" / "crashdump.json").read_text())
+    assert dump["reason"] == "signal:SIGTERM"
+    assert dump["proc"] == 1 and dump["nproc"] == 2
+    assert dump["scalars"]["loss/total/train"]  # divergence context
+    # The dump event was flushed into the stream before death.
+    kinds = [
+        json.loads(line)["kind"]
+        for line in (tmp_path / "p1" / "events.jsonl").read_text()
+        .splitlines()
+    ]
+    assert "crashdump" in kinds
+    report = postmortem_path(tmp_path)
+    assert report["exit_code"] == 2
+    statuses = {d["label"]: d["status"] for d in report["processes"]}
+    assert statuses == {"p0": "finished", "p1": "killed"}
+    assert "p1" in report["headline"]
+
+
+def test_hang_watchdog_dumps_in_simulated_fleet(tmp_path):
+    p0 = _spawn(tmp_path, 0, "healthy")
+    p1 = _spawn(tmp_path, 1, "victim-hang")
+    try:
+        _wait_line(p1, "ready")
+        _wait_line(p1, "dumped")  # the watchdog thread fired
+        assert p0.wait(timeout=30) == 0
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    dump = json.loads((tmp_path / "p1" / "crashdump.json").read_text())
+    assert dump["reason"].startswith("hang")
+    assert dump["phase"] == "train" and dump["epoch"] == 1
+    report = postmortem_path(tmp_path)
+    assert report["exit_code"] == 2
+    statuses = {d["label"]: d["status"] for d in report["processes"]}
+    assert statuses["p1"] == "hung"
+    assert "p1" in report["headline"]
+    assert "hang" in report["headline"]
+
+
+def test_postmortem_cli_is_jax_free(tmp_path):
+    # The CLI must work on a machine where importing jax would HANG (a
+    # wedged relay lease): prove it never imports jax by poisoning the
+    # import in a fresh interpreter.
+    run_root = tmp_path / "run"
+    p0 = _spawn(run_root, 0, "healthy")
+    assert p0.wait(timeout=30) == 0
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text(
+        "raise ImportError('postmortem CLI imported jax')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+         "postmortem", str(run_root)],
+        cwd=_REPO_ROOT,
+        env={
+            **os.environ,
+            "PYTHONPATH": f"{poison}:{_REPO_ROOT}",
+            "JAX_PROCESS_INDEX": "",  # don't inherit fleet identity
+        },
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 2, out.stderr  # 1 of 2 streams missing
+    assert "postmortem" in out.stdout
+    # And --selfcheck, the check.sh gate, under the same poison.
+    out = subprocess.run(
+        [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+         "postmortem", "--selfcheck"],
+        cwd=_REPO_ROOT,
+        env={**os.environ, "PYTHONPATH": f"{poison}:{_REPO_ROOT}"},
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr

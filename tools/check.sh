@@ -21,9 +21,13 @@ fi
 echo "== tracelint =="
 JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
 
-# 3. telemetry: hermetic registry -> events -> report smoke (jax-free).
+# 3. telemetry: hermetic registry -> events -> report smoke, plus the
+#    simulated-fleet flight-recorder -> aggregate -> postmortem smoke
+#    (both jax-free by contract — they must work on a wedged host).
 echo "== telemetry selfcheck =="
 python -m masters_thesis_tpu.telemetry selfcheck || fail=1
+echo "== telemetry postmortem selfcheck =="
+python -m masters_thesis_tpu.telemetry postmortem --selfcheck || fail=1
 
 if [ "${1:-}" = "--fast" ]; then
     exit $fail

@@ -179,6 +179,7 @@ def run(cfg: Config) -> float:
         resume=t.get("resume", False),
         preflight=t.get("preflight", False),
         telemetry=telemetry,
+        hang_timeout_s=t.get("hang_timeout_s", None),
     )
 
     init_state = None
