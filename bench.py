@@ -14,9 +14,14 @@ The single JSON line also carries (in "detail"):
 - ``nll``: the same measurement for loss=nll — the fused O(K·n)
   single-factor NLL (ops/losses.py) replacing the reference's dense
   O(K³) path (reference: src/model.py:44-69, src/common.py:50-78).
-- ``batch_sweep``: windows/sec at batch_size 1/8/32 — where throughput
-  saturates once the per-step dispatch floor is amortized (the tiny-batch
-  regime is the known TPU hard part, SURVEY.md §7).
+- ``batch_sweep``: windows/sec at batch_size 1/8/32 (unit recorded in the
+  object — r4 consumers misread the old flat map as steps/sec) plus the
+  Pallas window pack width per point — where throughput saturates once
+  the per-step dispatch floor is amortized (the tiny-batch regime is the
+  known TPU hard part, SURVEY.md §7).
+- ``collectives_per_step`` / ``grad_reduce_bytes``: the flat update
+  path's gradient-sync footprint (train/flatparams.py) — exactly one
+  fused pmean per step, and the bytes it moves.
 - ``scaling``: 1-device vs 8-device scan-epoch throughput on the virtual
   CPU mesh (run in a subprocess so the backend choice doesn't leak into
   this process) — strong scaling at fixed global batch (the honest
@@ -56,6 +61,51 @@ BASELINE_STEPS_PER_SEC = 200.0
 PROBE_TIMEOUT_S = 120.0
 PROBE_BUDGET_S = 600.0
 PROBE_BACKOFF_S = 15.0
+# The retry budget assumes the wedge MIGHT clear; when a probe (or a
+# mid-measurement watchdog kill) already established the lease is wedged
+# minutes ago, re-burning the full budget re-timing-out is pure waste —
+# BENCH_r05 spent all 600s on 5 consecutive timeouts against a lease a
+# previous run had already found dead. The last probe outcome is persisted
+# to results/probe_cache.json with a short TTL; within the TTL a
+# known-wedged lease gets ONE probe attempt (budget 0) and the run fails
+# over to CPU after the first timeout instead of retrying.
+PROBE_CACHE_TTL_S = 900.0
+
+
+def _probe_cache_path() -> Path:
+    return Path(__file__).resolve().parent / "results" / "probe_cache.json"
+
+
+def _read_probe_cache() -> dict | None:
+    """Last probe outcome, or None when absent/corrupt/expired."""
+    try:
+        cached = json.loads(_probe_cache_path().read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(cached, dict):
+        return None
+    at = cached.get("at")
+    if not isinstance(at, (int, float)) or time.time() - at > PROBE_CACHE_TTL_S:
+        return None
+    return cached
+
+
+def _write_probe_cache(ok: bool, detail: str) -> None:
+    """Best-effort: the cache must never cost the run its JSON line."""
+    try:
+        from masters_thesis_tpu.utils import atomic_write_text
+
+        path = _probe_cache_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        atomic_write_text(
+            path,
+            json.dumps(
+                {"ok": ok, "at": time.time(), "detail": detail[-500:]},
+                indent=2,
+            ),
+        )
+    except OSError:
+        pass
 
 # Scaled-down sample count (100k vs the reference's 1M bootstrap) keeps the
 # bench wall-clock to a couple of minutes; per-step work is IDENTICAL to the
@@ -69,15 +119,30 @@ def _ensure_responsive_backend() -> tuple[bool, int]:
     """Probe TPU init with retries; returns (degraded_to_cpu, attempts)."""
     from masters_thesis_tpu.utils import probe_tpu_backend
 
+    cached = _read_probe_cache()
+    known_wedged = cached is not None and not cached.get("ok")
+    if known_wedged:
+        # The cache says the lease was wedged minutes ago: ONE attempt
+        # (budget_s=0 -> no retries), then fail over to CPU on its first
+        # timeout instead of re-burning the 600s retry budget.
+        print(
+            "probe cache says lease was wedged "
+            f"{time.time() - cached['at']:.0f}s ago; single probe attempt",
+            file=sys.stderr,
+        )
+        budget_s = 0.0
+    else:
+        budget_s = PROBE_BUDGET_S
     probe = probe_tpu_backend(
         timeout_s=PROBE_TIMEOUT_S,
-        budget_s=PROBE_BUDGET_S,
+        budget_s=budget_s,
         backoff_s=PROBE_BACKOFF_S,
     )
+    _write_probe_cache(probe.ok, probe.detail or "")
     if probe.ok:
         return False, probe.attempts
     print(
-        f"device probe failed {probe.attempts}x over {PROBE_BUDGET_S:.0f}s "
+        f"device probe failed {probe.attempts}x over {budget_s:.0f}s "
         f"({probe.detail}); falling back to CPU backend",
         file=sys.stderr,
     )
@@ -305,6 +370,56 @@ def _enable_compile_cache() -> None:
     enable_persistent_compilation_cache()
 
 
+def _point_pack_width(batch_size: int, objective: str) -> int:
+    """Windows per Pallas program the kernel scheduler would pack for this
+    point's flattened row count on a TPU backend (1 = one window per
+    program, the serial fallback). Computed from the same fits predicate
+    the pair recurrence uses, so the reported width tracks the scheduler
+    rather than guessing. batch_size=1 is the single-program path."""
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.ops.lstm_kernel import pair_fits, window_pack_width
+
+    if batch_size <= 1:
+        return 1
+    spec = ModelSpec(objective=objective)
+    return window_pack_width(
+        batch_size * N_STOCKS,
+        N_STOCKS,
+        lambda rows: pair_fits(
+            60, rows, spec.hidden_size, spec.dropout > 0, 4
+        ),
+    )
+
+
+def _grad_sync_stats(objective: str) -> dict:
+    """Gradient-sync footprint of the flat update path at this model shape:
+    collectives per step (one per flat dtype buffer — the count TA206 pins
+    to 1) and the bytes one step's pmean reduces. Derived from the view
+    table (train/flatparams.py), not measured — the numbers are exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.train.flatparams import (
+        flat_size_bytes,
+        flatten_spec,
+        num_buffers,
+    )
+
+    spec = ModelSpec(objective=objective)
+    module = spec.build_module()
+    shapes = jax.eval_shape(
+        module.init,
+        jax.random.key(0),
+        jnp.zeros((1, 60, spec.input_size), jnp.float32),
+    )
+    fspec = flatten_spec(shapes["params"])
+    return {
+        "collectives_per_step": num_buffers(fspec),
+        "grad_reduce_bytes": flat_size_bytes(fspec),
+    }
+
+
 def _point_child(objective: str, batch_size: int, epochs: int) -> None:
     """Measure one (objective, batch_size) point; prints one JSON line."""
     _enable_compile_cache()
@@ -342,6 +457,8 @@ def _point_child(objective: str, batch_size: int, epochs: int) -> None:
         "steps_per_sec": sps,
         "platform": jax.devices()[0].platform,
         "windows_per_epoch": len(dm.train_range),
+        "pack_width": _point_pack_width(batch_size, objective),
+        "grad_sync": _grad_sync_stats(objective),
         "telemetry": None if tel is None else str(tel.run_dir),
     }))
 
@@ -516,6 +633,12 @@ def main() -> None:
         ))
         if not _point_ok(headline):
             degraded = True
+            # A mid-measurement hang is the same wedged-lease evidence a
+            # failed probe is: record it so the NEXT run (within the TTL)
+            # goes straight to the single-attempt probe.
+            _write_probe_cache(
+                False, f"headline point failed: {headline.get('reason')}"
+            )
             _pin_cpu_in_process()
 
     # CPU fallback is ~300x slower per step: trim the measurement window so
@@ -524,6 +647,8 @@ def main() -> None:
     # state can't leak into a child whose env pins CPU before jax imports);
     # in-process only as a last resort, with the platform pinned.
     measure_epochs = 2 if degraded else MEASURE_EPOCHS
+    grad_sync = None
+    pack_widths: dict[str, int | None] = {}
     if degraded:
         point = collect(_measure_point(
             "mse", 1, measure_epochs, POINT_TIMEOUT_AUX_S, force_cpu=True
@@ -532,6 +657,8 @@ def main() -> None:
             value = point["steps_per_sec"]
             windows_per_epoch = point["windows_per_epoch"]
             platform = point["platform"]
+            grad_sync = point.get("grad_sync")
+            pack_widths["1"] = point.get("pack_width", 1)
         else:
             _pin_cpu_in_process()
             dm1 = FinancialWindowDataModule(
@@ -545,10 +672,14 @@ def main() -> None:
             import jax
 
             platform = jax.devices()[0].platform
+            grad_sync = _grad_sync_stats("mse")
+            pack_widths["1"] = 1
     else:
         value = headline["steps_per_sec"]
         windows_per_epoch = headline["windows_per_epoch"]
         platform = headline["platform"]
+        grad_sync = headline.get("grad_sync")
+        pack_widths["1"] = headline.get("pack_width", 1)
 
     # Degraded (wedged relay, CPU fallback): the probe/watchdog already
     # burned its budget — measure ONLY the headline point so the one JSON
@@ -570,6 +701,7 @@ def main() -> None:
                                            POINT_TIMEOUT_AUX_S))
             if _point_ok(point):
                 batch_sweep[str(bs)] = round(point["steps_per_sec"] * bs, 2)
+                pack_widths[str(bs)] = point.get("pack_width")
         scaling = _run_scaling_subprocess()
     wall = time.perf_counter() - t0
 
@@ -593,6 +725,30 @@ def main() -> None:
             "nll_steps_per_sec": (
                 None if nll_sps is None else round(nll_sps, 2)
             ),
+            # Flat update path (train/flatparams.py): collectives per
+            # compiled train step (TA206 pins this to 1) and the bytes one
+            # step's fused pmean reduces across the mesh.
+            "collectives_per_step": (
+                None if grad_sync is None
+                else grad_sync.get("collectives_per_step")
+            ),
+            "grad_reduce_bytes": (
+                None if grad_sync is None
+                else grad_sync.get("grad_reduce_bytes")
+            ),
+            # Sweep values are windows/sec (= steps/sec * batch_size), NOT
+            # steps/sec like the top-level `value` — r4 consumers misread
+            # the old flat map as steps/sec, so the unit is now explicit.
+            # pack_width: windows the Pallas scheduler packs per program at
+            # each point's row count (1 = serial window-per-program).
+            "batch_sweep": {
+                "unit": "windows_per_sec",
+                "headline_unit": "steps_per_sec (top-level value)",
+                "points": batch_sweep,
+                "pack_width": pack_widths,
+            },
+            # Deprecated flat alias of batch_sweep["points"]; kept one
+            # round for cross-round consumers.
             "batch_sweep_windows_per_sec": batch_sweep,
             "scaling": scaling,
             # r2/r3 artifacts exposed the strong-scaling record under this

@@ -20,6 +20,12 @@ and asserts the invariants the framework's performance contract rests on:
   accumulate in float32.
 - **TA205** — the audit itself could not run; the finding carries the
   exception. Infrastructure failures must be loud, not a green check.
+- **TA206** — the per-step hot path syncs gradients as exactly ONE
+  cross-replica reduction: the compiled epoch program's while-loop body
+  contains a single ``all-reduce`` (the flat-buffer ``pmean``,
+  train/flatparams.py). A second in-loop collective means the flat update
+  path regressed to per-leaf reductions — the r4 sharding-overhead bug
+  class (8-device slower than 1 at equal total work, RESULTS.md).
 
 Everything is sized to run in seconds on CPU (``JAX_PLATFORMS=cpu`` with
 the 8-device virtual mesh) — the same invariants transfer to TPU because
@@ -27,6 +33,8 @@ they are properties of the traced program, not the backend.
 """
 
 from __future__ import annotations
+
+import re
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +48,31 @@ AUDIT_LOOKBACK = 8
 AUDIT_FEATURES = 3
 AUDIT_BATCH = 2
 AUDIT_STEPS = 3
+
+
+def count_step_collectives(compiled_hlo: str) -> int:
+    """Count cross-replica reductions in the per-step hot path (TA206).
+
+    Counts compiled-HLO ``all-reduce`` ops whose ``op_name`` metadata
+    places them inside the scan's while-loop body (``.../while/body/...``).
+    The epoch program legitimately owns other collectives — the metric
+    ``psum`` (once per epoch, after the scan) and the shuffle permutation's
+    sort machinery (epoch setup) — but those run per EPOCH; only while-body
+    ops pay per step. Shared with telemetry/bench so "collectives per step"
+    means the same thing everywhere.
+    """
+    n = 0
+    for line in compiled_hlo.splitlines():
+        if _ALL_REDUCE_RE.search(line) is None:
+            continue
+        op_name = _OP_NAME_RE.search(line)
+        if op_name is not None and "while/body" in op_name.group(1):
+            n += 1
+    return n
+
+
+_ALL_REDUCE_RE = re.compile(r"= \S+ all-reduce(?:-start)?\(")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
 
 
 class PreflightError(RuntimeError):
@@ -107,7 +140,7 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
         make_data_mesh,
         replicated_sharding,
     )
-    from masters_thesis_tpu.train.optim import make_optimizer
+    from masters_thesis_tpu.train.flatparams import FlatAdam
     from masters_thesis_tpu.train.steps import make_train_epoch
 
     findings: list[Finding] = []
@@ -121,7 +154,9 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
 
     module = spec.build_module()
     objective = spec.window_objective()
-    tx = make_optimizer(None, spec.weight_decay)
+    # The audit runs the flat update path — the one the Trainer runs — so
+    # TA206's "one collective per step" is checked on the real program.
+    tx = FlatAdam(None, spec.weight_decay)
 
     rng = np.random.default_rng(0)
     n_windows = mesh.size * AUDIT_BATCH * 2
@@ -169,6 +204,18 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
                 )
             )
         compiled = lowered.compile()
+        # --------------------------------------------------------- TA206
+        n_reduce = count_step_collectives(compiled.as_text())
+        if n_reduce != 1:
+            findings.append(
+                Finding(
+                    rule="TA206",
+                    message=f"compiled train step contains {n_reduce} "
+                    "cross-replica reductions in the scan body (expected "
+                    "exactly 1: the flat-buffer gradient pmean) — the "
+                    "update path is reducing per leaf again",
+                )
+            )
         arg_shardings = compiled.input_shardings[0]
         param_sh = _leaf_shardings(arg_shardings[0])
         if not all(s.is_fully_replicated for s in param_sh):

@@ -1505,19 +1505,27 @@ def lstm_stack_recurrence(
             n_t, window_rows, hidden, ell, has_mask, itemsize
         ):
             interpret = impl == "interpret"
+            pack = window_pack_width(
+                batch,
+                window_rows,
+                lambda rows: stack_fits(
+                    n_t, rows, hidden, ell, has_mask, itemsize
+                ),
+            )
+            n_chunks = batch // (pack * window_rows)
             if masks is None:
                 return _map_row_chunks(
                     lambda xs: _lstm_stack_pallas(
                         xs[0], weights, None, interpret
                     ),
-                    batch // window_rows,
+                    n_chunks,
                     x1_proj,
                 )
             return _map_row_chunks(
                 lambda xs: _lstm_stack_pallas(
                     xs[0], weights, tuple(xs[1:]), interpret
                 ),
-                batch // window_rows,
+                n_chunks,
                 x1_proj,
                 *masks,
             )
@@ -1578,19 +1586,27 @@ def lstm_pair_recurrence(
             n_t, window_rows, hidden, has_mask=has_mask, itemsize=itemsize
         ):
             interpret = impl == "interpret"
+            pack = window_pack_width(
+                b,
+                window_rows,
+                lambda rows: pair_fits(
+                    n_t, rows, hidden, has_mask=has_mask, itemsize=itemsize
+                ),
+            )
+            n_chunks = b // (pack * window_rows)
             if mask is None:
                 return _map_row_chunks(
                     lambda xs: _lstm_pair_pallas_nomask(
                         xs[0], w_hh1_t, w_ih2_t, bias2, w_hh2_t, interpret
                     ),
-                    b // window_rows,
+                    n_chunks,
                     x1_proj,
                 )
             return _map_row_chunks(
                 lambda xs: _lstm_pair_pallas(
                     xs[0], w_hh1_t, w_ih2_t, bias2, w_hh2_t, xs[1], interpret
                 ),
-                b // window_rows,
+                n_chunks,
                 x1_proj,
                 mask,
             )
@@ -1622,9 +1638,13 @@ def lstm_pair_recurrence(
 # anyway, so scheduling the batch as a ``lax.map`` over windows — each
 # iteration one single-program kernel at the window's own row count — keeps
 # every recurrent matmul at the ~104-row MXU shape and recovers flat
-# per-window cost. Callers that know the window size (the train/eval steps
-# flatten it themselves) pass ``window_rows``; without it behavior is
-# unchanged.
+# per-window cost. When K rows per window sits well below the VMEM budget,
+# ``window_pack_width`` packs several whole windows into one program (one
+# wavefront over the concatenated row axis — rows are independent, so the
+# packed result is bitwise the per-window result) so small-universe batches
+# don't pay one program launch per window. Callers that know the window
+# size (the train/eval steps flatten it themselves) pass ``window_rows``;
+# without it behavior is unchanged.
 
 
 def _map_row_chunks(fn, n_chunks: int, *arrays):
@@ -1651,6 +1671,33 @@ def window_schedulable(b: int, window_rows: int | None) -> bool:
         and 0 < window_rows < b
         and b % window_rows == 0
     )
+
+
+def window_pack_width(b: int, window_rows: int | None, fits) -> int:
+    """Windows per Pallas program under a VMEM feasibility predicate.
+
+    One window per program keeps the recurrent matmuls at good MXU shapes,
+    but when K rows per window is far below the single-program row budget
+    (small universes), serializing one K-row program per window leaves the
+    budget idle and pays a program launch per window. Packing p windows
+    into one program — one wavefront over the concatenated row axis, legal
+    because rows are independent across the batch dim — gives flat
+    per-window cost up to the budget.
+
+    Returns the largest ``p`` dividing the window count with
+    ``fits(p * window_rows)`` true (``fits`` is the caller's byte-model
+    check at a row count: single_layer_fits / pair_fits / stack_fits plus
+    any row-cap). Degenerates to 1 — today's serial window-per-program
+    schedule — when nothing larger fits; callers never lose the fallback.
+    """
+    if not window_schedulable(b, window_rows):
+        return 1
+    n_windows = b // window_rows
+    best = 1
+    for p in range(2, n_windows + 1):
+        if n_windows % p == 0 and fits(p * window_rows):
+            best = p
+    return best
 
 
 # -------------------------------------------------------------- public API
@@ -1728,9 +1775,15 @@ def lstm_recurrence(
             and -(-window_rows // 8) * 8 <= SINGLE_TILE_MAX_ROWS
             and single_layer_fits(n_t, window_rows, hidden, itemsize)
         ):
+            pack = window_pack_width(
+                b,
+                window_rows,
+                lambda rows: -(-rows // 8) * 8 <= SINGLE_TILE_MAX_ROWS
+                and single_layer_fits(n_t, rows, hidden, itemsize),
+            )
             return _map_row_chunks(
                 lambda xs: _lstm_recurrence_pallas(xs[0], w_hh_t, interpret),
-                b // window_rows,
+                b // (pack * window_rows),
                 x_proj,
             )
         if single_layer_fits(n_t, b, hidden, itemsize):

@@ -121,6 +121,10 @@ def summarize_events(events: list[dict]) -> dict:
     )
 
     preflight = (by_kind.get("preflight") or [{}])[-1]
+    # Gradient-sync footprint (flat update path, train/flatparams.py): the
+    # trainer records one grad_sync event per run — collectives per step
+    # (the TA206-pinned count) and bytes moved by the flat-buffer pmean.
+    grad_sync = (by_kind.get("grad_sync") or [{}])[-1]
     profile_windows = [
         {k: e.get(k) for k in ("start_epoch", "end_epoch", "trace_dir")}
         for e in by_kind.get("profile_window", [])
@@ -165,6 +169,11 @@ def summarize_events(events: list[dict]) -> dict:
             "peak_bytes_in_use": peak_bytes,
             "live_buffer_bytes": live_bytes,
             "source": mem_events[-1].get("source") if mem_events else None,
+        },
+        "grad_sync": {
+            "collectives_per_step": grad_sync.get("collectives_per_step"),
+            "grad_reduce_bytes": grad_sync.get("grad_reduce_bytes"),
+            "flat_buffers": grad_sync.get("flat_buffers"),
         },
         "preflight": preflight.get("status"),
         "diverged": finished.get("diverged"),
@@ -246,6 +255,14 @@ def render_text(report: dict) -> str:
         f"source: {mem['source'] or 'n/a'})",
         f"preflight      : {report.get('preflight') or 'not recorded'}",
     ]
+    gs = report.get("grad_sync") or {}
+    if gs.get("collectives_per_step") is not None:
+        lines.insert(
+            len(lines) - 1,
+            f"grad sync      : {gs['collectives_per_step']} collective(s)"
+            f"/step, {_fmt_bytes(gs['grad_reduce_bytes'])} reduced/step "
+            f"({gs.get('flat_buffers')} flat buffer(s))",
+        )
     for w in report.get("profile_windows", []):
         lines.append(
             f"profiler trace : epochs {w['start_epoch']}..{w['end_epoch']} "

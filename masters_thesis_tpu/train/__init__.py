@@ -7,16 +7,40 @@ in-tree, TPU-first:
 
 - :mod:`steps`: the whole training epoch is ONE jitted ``shard_map`` +
   ``lax.scan`` program — no per-step host round trips at all.
+- :mod:`flatparams`: the flat-buffer update path — params/grads/moments as
+  one contiguous per-dtype buffer, ONE ``pmean`` per step over it (TA206),
+  one fused Adam pass; bit-identical to the optax chain.
 - :mod:`optim`: optax chain matching torch ``Adam(weight_decay=...)`` +
-  Lightning ``gradient_clip_val`` semantics, plus a host-side
-  ReduceLROnPlateau equivalent.
+  Lightning ``gradient_clip_val`` semantics (kept as the parity reference
+  for the flat path), plus a host-side ReduceLROnPlateau equivalent.
 - :mod:`checkpoint`: Orbax best/last checkpoints with hparams sidecars.
 - :mod:`logging`: TensorBoard scalars/hparams/figures (same taxonomy as the
   reference's TensorBoardLogger).
 - :mod:`trainer`: the fit/test orchestration loop.
 """
 
+from masters_thesis_tpu.train.flatparams import (
+    FlatAdam,
+    FlatOptState,
+    flat_size_bytes,
+    flatten,
+    flatten_spec,
+    num_buffers,
+    unflatten,
+)
 from masters_thesis_tpu.train.optim import PlateauScheduler, make_optimizer
 from masters_thesis_tpu.train.trainer import Trainer, TrainResult
 
-__all__ = ["PlateauScheduler", "make_optimizer", "Trainer", "TrainResult"]
+__all__ = [
+    "FlatAdam",
+    "FlatOptState",
+    "PlateauScheduler",
+    "Trainer",
+    "TrainResult",
+    "flat_size_bytes",
+    "flatten",
+    "flatten_spec",
+    "make_optimizer",
+    "num_buffers",
+    "unflatten",
+]

@@ -30,6 +30,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.train import flatparams
 from masters_thesis_tpu.utils import atomic_write_text
 
 
@@ -66,6 +67,17 @@ def save_checkpoint(
         staged_sidecar.unlink(missing_ok=True)
         if staging.exists():
             shutil.rmtree(staging)
+    # Flat optimizer states (train/flatparams.py) are stored UNFLATTENED
+    # through the view table: the on-disk layout is the params-shaped moment
+    # pytree an optax checkpoint would hold, independent of the flat
+    # buffers' internal leaf order — a layout refactor must not invalidate
+    # every checkpoint. The restore side re-flattens against the current
+    # params (restore_opt_state(params=...)).
+    host_state = jax.device_get(opt_state)
+    if isinstance(host_state, flatparams.FlatOptState):
+        host_state = flatparams.to_portable(
+            host_state, jax.device_get(params)
+        )
     with ocp.StandardCheckpointer() as ckptr:
         # to_state_dict turns optax namedtuple states into pure dicts, so the
         # restore side can rebuild any optimizer structure via from_state_dict
@@ -74,7 +86,7 @@ def save_checkpoint(
             staging,
             {
                 "params": params,
-                "opt_state": fser.to_state_dict(jax.device_get(opt_state)),
+                "opt_state": fser.to_state_dict(host_state),
             },
         )
         ckptr.wait_until_finished()
@@ -194,8 +206,23 @@ def restore_checkpoint(
     return tree["params"], tree["opt_state"], spec, sidecar["meta"]
 
 
-def restore_opt_state(template: Any, raw: Any) -> Any:
-    """Rebuild an optax state pytree from its checkpointed state dict."""
+def restore_opt_state(template: Any, raw: Any, params: Any = None) -> Any:
+    """Rebuild an optimizer state pytree from its checkpointed state dict.
+
+    For a flat optimizer state (``template`` is a
+    :class:`~masters_thesis_tpu.train.flatparams.FlatOptState`) the
+    checkpoint holds params-shaped moment pytrees; ``params`` provides the
+    view table to re-flatten them against (required in that case).
+    """
+    if isinstance(template, flatparams.FlatOptState):
+        if params is None:
+            raise ValueError(
+                "restoring a flat optimizer state needs params= for the "
+                "view table"
+            )
+        portable_template = flatparams.to_portable(template, params)
+        raw = fser.from_state_dict(portable_template, raw)
+        return flatparams.from_portable(raw, params)
     return fser.from_state_dict(template, raw)
 
 

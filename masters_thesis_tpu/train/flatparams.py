@@ -1,0 +1,261 @@
+"""Flat-parameter buffers: one contiguous view of the whole model.
+
+The r4 bench showed the multi-chip hot loop paying per-*leaf* overhead:
+``lax.pmean(grads)`` over the param pytree lowers to one all-reduce per
+leaf (8 for even the 1-layer audit model), so every step pays N collective
+launches for a few hundred KB of gradient. Production JAX trainers flatten
+the pytree into one contiguous buffer and reduce THAT (PAPERS.md: pjit
+LM-training at scale; TorchTitan's bucketed flat all-reduce). This module
+is that layout:
+
+- :func:`flatten_spec` walks the pytree once and records a static **view
+  table**: for every leaf, which per-dtype buffer it lives in, at what
+  offset, with what shape. The spec is pure Python (hashable metadata, no
+  arrays) — it is closed over at trace time, never traced itself.
+- :func:`flatten` / :func:`unflatten` move values between the pytree and
+  the per-dtype 1-D buffers. Both are pure layout ops (reshape + concat /
+  static slice) — XLA fuses them into the neighbouring computation, and
+  ``unflatten(flatten(t)) == t`` bit-for-bit.
+- :class:`FlatAdam` is the repo's optimizer chain (global-norm clip ->
+  L2 decay -> Adam moments, ``train/optim.py:make_optimizer``) re-stated
+  over the flat buffers: moments are stored flat, every update op is one
+  elementwise pass over the whole buffer, and the only reduction is the
+  clip norm. Formulas replicate optax 0.2.3 term-for-term (including
+  ``safe_int32_increment`` and the bias-correction dtype dance) so the
+  flat path is **bit-identical** to the pytree path — asserted by
+  ``tests/test_flatparams.py`` over multi-epoch runs on the 8-device mesh.
+
+The payoff in ``train/steps.py``: the cross-chip gradient sync becomes
+exactly ONE ``lax.pmean`` over the flat buffer per step (trace-audit rule
+TA206 pins this in the lowered HLO), and the Adam update is one fused
+elementwise kernel instead of a ragged per-leaf sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LeafView(NamedTuple):
+    """Where one pytree leaf lives inside the flat per-dtype buffers."""
+
+    key: str  # dtype buffer key, e.g. "float32"
+    offset: int  # element offset into that buffer
+    size: int  # element count
+    shape: tuple  # original leaf shape
+
+
+class FlatSpec(NamedTuple):
+    """Static view table mapping a pytree onto per-dtype flat buffers.
+
+    ``views`` follow ``jax.tree_util.tree_leaves`` order — the same order
+    optax's ``global_norm`` sums leaf norms in, which is what lets the
+    flat clip reduction reproduce the pytree clip bit-for-bit.
+    """
+
+    treedef: Any
+    views: tuple  # tuple[LeafView, ...] in tree_leaves order
+    sizes: tuple  # tuple[(key, total elements), ...] per dtype buffer
+    dtypes: tuple  # tuple[(key, dtype), ...] per dtype buffer
+
+
+def flatten_spec(tree) -> FlatSpec:
+    """Build the view table for ``tree`` (arrays, tracers, or ShapeDtypeStructs)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    offsets: dict[str, int] = {}
+    dtypes: dict[str, Any] = {}
+    views = []
+    for leaf in leaves:
+        dtype = jnp.dtype(leaf.dtype)
+        key = dtype.name
+        dtypes.setdefault(key, dtype)
+        off = offsets.get(key, 0)
+        size = 1
+        for d in leaf.shape:
+            size *= int(d)
+        views.append(LeafView(key, off, size, tuple(int(d) for d in leaf.shape)))
+        offsets[key] = off + size
+    return FlatSpec(
+        treedef=treedef,
+        views=tuple(views),
+        sizes=tuple(sorted(offsets.items())),
+        dtypes=tuple(sorted(dtypes.items())),
+    )
+
+
+def flatten(tree, spec: FlatSpec) -> dict:
+    """Pack a pytree (matching ``spec``'s treedef) into per-dtype 1-D buffers."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    segments: dict[str, list] = {key: [] for key, _ in spec.sizes}
+    for leaf, view in zip(leaves, spec.views):
+        segments[view.key].append(jnp.reshape(leaf, (view.size,)))
+    return {
+        key: (segs[0] if len(segs) == 1 else jnp.concatenate(segs))
+        for key, segs in segments.items()
+    }
+
+
+def unflatten(bufs: dict, spec: FlatSpec):
+    """Carve the per-dtype buffers back into the original pytree (views only)."""
+    leaves = [
+        jnp.reshape(bufs[v.key][v.offset : v.offset + v.size], v.shape)
+        for v in spec.views
+    ]
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def flat_size_bytes(spec: FlatSpec) -> int:
+    """Total bytes of the flat buffers == bytes moved by the one pmean."""
+    dtypes = dict(spec.dtypes)
+    return sum(n * jnp.dtype(dtypes[key]).itemsize for key, n in spec.sizes)
+
+
+def num_buffers(spec: FlatSpec) -> int:
+    """Distinct dtype buffers == collectives per step on the flat path."""
+    return len(spec.sizes)
+
+
+def _leaf_square_sum(bufs: dict, spec: FlatSpec):
+    """``sum(||leaf||^2)`` over views, replicating optax's ``global_norm``.
+
+    Each segment is reshaped back to the leaf's shape before ``jnp.sum`` so
+    the per-leaf reduction XLA sees (shape, order) is identical to the one
+    the pytree path runs — that, plus Python-ordered accumulation across
+    leaves, is what makes the clip trigger bit-identical.
+    """
+    return sum(
+        jnp.sum(
+            jnp.square(
+                jnp.reshape(bufs[v.key][v.offset : v.offset + v.size], v.shape)
+            )
+        )
+        for v in spec.views
+    )
+
+
+class FlatOptState(NamedTuple):
+    """Adam state over flat buffers; a plain pytree (donate/global_put safe)."""
+
+    count: jax.Array  # int32 scalar, safe-incremented like optax
+    mu: dict  # per-dtype first-moment buffers
+    nu: dict  # per-dtype second-moment buffers
+
+
+class FlatAdam:
+    """``make_optimizer``'s chain, fused over flat buffers.
+
+    Same contract as the optax chain it replaces: ``update_flat`` returns
+    the ASCENT direction (the caller applies ``p - lr * u``), clip runs on
+    raw (already pmean'd) gradients, L2 decay folds ``wd * p`` into the
+    clipped gradient before the moment updates (torch-Adam semantics, not
+    AdamW), and the moments/bias-correction match optax's ``scale_by_adam``
+    term-for-term.
+    """
+
+    def __init__(
+        self,
+        gradient_clip_val: float | None = None,
+        weight_decay: float = 0.0,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+        eps_root: float = 0.0,
+    ):
+        self.gradient_clip_val = (
+            float(gradient_clip_val)
+            if gradient_clip_val is not None and gradient_clip_val > 0
+            else None
+        )
+        self.weight_decay = float(weight_decay)
+        self.b1 = b1
+        self.b2 = b2
+        self.eps = eps
+        self.eps_root = eps_root
+
+    def init(self, params) -> FlatOptState:
+        spec = flatten_spec(params)
+        dtypes = dict(spec.dtypes)
+
+        # Distinct arrays per moment: mu and nu sharing one zeros buffer
+        # trips XLA's "same buffer donated twice" check under donate_argnums.
+        def zeros():
+            return {key: jnp.zeros((n,), dtypes[key]) for key, n in spec.sizes}
+
+        return FlatOptState(
+            count=jnp.zeros([], jnp.int32), mu=zeros(), nu=zeros()
+        )
+
+    def update_flat(
+        self, gbufs: dict, state: FlatOptState, pbufs: dict, spec: FlatSpec
+    ) -> tuple[dict, FlatOptState]:
+        """One fused elementwise pass: (grad bufs, state, param bufs) -> (updates, state)."""
+        if self.gradient_clip_val is not None:
+            max_norm = self.gradient_clip_val
+            g_norm = jnp.sqrt(_leaf_square_sum(gbufs, spec))
+            trigger = jnp.squeeze(g_norm < max_norm)
+            gbufs = {
+                k: jax.lax.select(
+                    trigger, g, (g / g_norm.astype(g.dtype)) * max_norm
+                )
+                for k, g in gbufs.items()
+            }
+        if self.weight_decay:
+            wd = self.weight_decay
+            gbufs = {k: g + wd * pbufs[k] for k, g in gbufs.items()}
+        b1, b2 = self.b1, self.b2
+        mu = {k: (1 - b1) * g + b1 * state.mu[k] for k, g in gbufs.items()}
+        nu = {k: (1 - b2) * (g**2) + b2 * state.nu[k] for k, g in gbufs.items()}
+        # optax.safe_int32_increment: saturate at int32 max instead of wrapping.
+        max_i32 = jnp.iinfo(jnp.int32).max
+        one = jnp.array(1, jnp.int32)
+        count_inc = jnp.where(state.count < max_i32, state.count + one, max_i32)
+        bc1 = 1 - b1**count_inc
+        bc2 = 1 - b2**count_inc
+        mu_hat = {k: m / bc1.astype(m.dtype) for k, m in mu.items()}
+        nu_hat = {k: v / bc2.astype(v.dtype) for k, v in nu.items()}
+        updates = {
+            k: mu_hat[k] / (jnp.sqrt(nu_hat[k] + self.eps_root) + self.eps)
+            for k in mu_hat
+        }
+        return updates, FlatOptState(count=count_inc, mu=mu, nu=nu)
+
+    def update(self, grads, state: FlatOptState, params):
+        """Pytree-facing adapter (the stream-mode step uses this): flatten,
+        run the fused pass, unflatten the updates."""
+        spec = flatten_spec(params)
+        ubufs, state = self.update_flat(
+            flatten(grads, spec), state, flatten(params, spec), spec
+        )
+        return unflatten(ubufs, spec), state
+
+
+# -------------------------------------------------- checkpoint portability
+#
+# The on-disk layout must not depend on the flat buffer layout (leaf order
+# inside a buffer is an implementation detail that the next refactor may
+# change). Checkpoints therefore store the moments UNFLATTENED through the
+# view table — the same params-shaped pytree an optax checkpoint holds —
+# and the restore side re-flattens against the CURRENT spec.
+
+
+def to_portable(state: FlatOptState, params) -> dict:
+    """FlatOptState -> layout-independent state dict (moments as pytrees)."""
+    spec = flatten_spec(params)
+    return {
+        "count": state.count,
+        "mu": unflatten(state.mu, spec),
+        "nu": unflatten(state.nu, spec),
+    }
+
+
+def from_portable(raw: dict, params) -> FlatOptState:
+    """Inverse of :func:`to_portable`, flattening against params' spec."""
+    spec = flatten_spec(params)
+    return FlatOptState(
+        count=jnp.asarray(raw["count"], jnp.int32),
+        mu={k: jnp.asarray(v) for k, v in flatten(raw["mu"], spec).items()},
+        nu={k: jnp.asarray(v) for k, v in flatten(raw["nu"], spec).items()},
+    )

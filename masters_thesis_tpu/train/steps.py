@@ -36,6 +36,12 @@ from masters_thesis_tpu.models.objectives import (
     nll_window,
 )
 from masters_thesis_tpu.parallel import DATA_AXIS, shard_map
+from masters_thesis_tpu.train.flatparams import (
+    FlatAdam,
+    flatten,
+    flatten_spec,
+    unflatten,
+)
 
 
 def jit_cache_size(fn) -> int | None:
@@ -64,9 +70,12 @@ def forward_rows(module, params, x, dropout_rng=None):
     deterministic = dropout_rng is None
     rngs = None if deterministic else {"dropout": dropout_rng}
     # window_rows=k tells the recurrence where the window boundaries are in
-    # the flattened row axis, so bs>1 batches schedule window-per-Pallas-
-    # program instead of falling onto the row-tiled grid (the bs>1
-    # throughput cliff, RESULTS.md).
+    # the flattened row axis, so bs>1 batches schedule windows onto
+    # single-program Pallas kernels instead of falling onto the row-tiled
+    # grid (the bs>1 throughput cliff, RESULTS.md). The kernel layer packs
+    # as many whole windows per program as its VMEM budget admits
+    # (ops/lstm_kernel.py:window_pack_width), so small-K batches amortize
+    # program launches instead of running K-row programs serially.
     alpha, beta = module.apply(
         {"params": params}, rows, deterministic=deterministic, rngs=rngs,
         window_rows=k,
@@ -125,6 +134,7 @@ def make_train_epoch(
     """
 
     loss_fn = _make_loss_fn(module, window_objective)
+    flat = isinstance(tx, FlatAdam)
 
     def local_epoch(params, opt_state, lr, rng, data: Batch):
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
@@ -133,6 +143,10 @@ def make_train_epoch(
         n_steps = n_local // batch_size
         perm = jax.random.permutation(shuffle_rng, n_local)
         idx = perm[: n_steps * batch_size].reshape(n_steps, batch_size)
+        # Flat path: the scan carries params as per-dtype flat buffers; the
+        # view table is static (trace-time Python), so pack/unpack are pure
+        # layout ops XLA folds into the neighbouring computation.
+        spec = flatten_spec(params) if flat else None
 
         def step(carry, inp):
             params, opt_state, sums = carry
@@ -141,23 +155,40 @@ def make_train_epoch(
             batch = Batch(
                 *(jnp.take(a, batch_idx, axis=0) for a in data)
             )
+            params_t = unflatten(params, spec) if flat else params
             (_, step_sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, step_rng, batch
+                params_t, step_rng, batch
             )
             # Equal per-device batch sizes => pmean of local-mean grads is
             # the global-batch gradient (the DDP all-reduce, on ICI).
-            grads = lax.pmean(grads, DATA_AXIS)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = jax.tree_util.tree_map(
-                lambda p, u: p - lr * u.astype(p.dtype), params, updates
-            )
+            if flat:
+                # ONE collective per step: the whole gradient crosses ICI as
+                # a single contiguous buffer per dtype (TA206 pins this in
+                # the lowered HLO) instead of one all-reduce per pytree leaf.
+                gbufs = lax.pmean(flatten(grads, spec), DATA_AXIS)
+                ubufs, opt_state = tx.update_flat(
+                    gbufs, opt_state, params, spec
+                )
+                params = {
+                    k: p - lr * ubufs[k].astype(p.dtype)
+                    for k, p in params.items()
+                }
+            else:
+                grads = lax.pmean(grads, DATA_AXIS)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(
+                    lambda p, u: p - lr * u.astype(p.dtype), params, updates
+                )
             sums = _accumulate(sums, step_sums)
             return (params, opt_state, sums), None
 
         zero = _zero_sums(tuple(metric_keys) + ("total",))
+        carry0 = (flatten(params, spec) if flat else params, opt_state, zero)
         (params, opt_state, sums), _ = lax.scan(
-            step, (params, opt_state, zero), (jnp.arange(n_steps), idx)
+            step, carry0, (jnp.arange(n_steps), idx)
         )
+        if flat:
+            params = unflatten(params, spec)
         sums = lax.psum(sums, DATA_AXIS)
         return params, opt_state, sums
 
@@ -195,7 +226,11 @@ def make_train_step(
     Unlike :func:`make_train_epoch` this is the pjit path: the batch arrives
     sharded on its window axis (the prefetcher places it), params arrive
     replicated, and XLA's sharding propagation inserts the gradient
-    all-reduce — no explicit collectives in user code.
+    all-reduce — no explicit collectives in user code. With a
+    :class:`FlatAdam` optimizer the gradients land in the per-dtype flat
+    buffers before the optimizer fold, so the partitioner reduces one
+    contiguous buffer per dtype (XLA's all-reduce combiner sees a single
+    fusable producer) and the Adam update runs as one elementwise pass.
 
     With ``weighted=True`` the step takes an extra ``(B,)`` weight vector
     and optimizes the weighted-mean loss. The trainer uses this to run the
@@ -212,14 +247,28 @@ def make_train_step(
             alpha, beta, batch.y, batch.factor, batch.inv_psi, weights=weights
         )
 
+    flat = isinstance(tx, FlatAdam)
+
     def step_core(params, opt_state, lr, rng, batch: Batch, weights):
         (_, sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, rng, batch, weights
         )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: p - lr * u.astype(p.dtype), params, updates
-        )
+        if flat:
+            spec = flatten_spec(params)
+            pbufs = flatten(params, spec)
+            ubufs, opt_state = tx.update_flat(
+                flatten(grads, spec), opt_state, pbufs, spec
+            )
+            pbufs = {
+                k: p - lr * ubufs[k].astype(p.dtype)
+                for k, p in pbufs.items()
+            }
+            params = unflatten(pbufs, spec)
+        else:
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p - lr * u.astype(p.dtype), params, updates
+            )
         return params, opt_state, sums
 
     repl = NamedSharding(mesh, P())

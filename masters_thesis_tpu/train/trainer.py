@@ -48,7 +48,13 @@ from masters_thesis_tpu.telemetry import (
 )
 from masters_thesis_tpu.train import checkpoint as ckpt_lib
 from masters_thesis_tpu.train.logging import TensorBoardLogger
-from masters_thesis_tpu.train.optim import PlateauScheduler, make_optimizer
+from masters_thesis_tpu.train.flatparams import (
+    FlatAdam,
+    flat_size_bytes,
+    flatten_spec,
+    num_buffers,
+)
+from masters_thesis_tpu.train.optim import PlateauScheduler
 from masters_thesis_tpu.train.steps import (
     jit_cache_size,
     make_eval_fn,
@@ -290,7 +296,12 @@ class Trainer:
 
         from masters_thesis_tpu.parallel import replicated_sharding
 
-        tx = make_optimizer(self.gradient_clip_val, spec.weight_decay)
+        # The flat update path (train/flatparams.py): moments live in
+        # per-dtype flat buffers, the per-step gradient sync is ONE pmean
+        # over the flat buffer (TA206), and the Adam fold is one fused
+        # elementwise pass. Same chain semantics as optim.make_optimizer —
+        # bit-identical updates, asserted by tests/test_flatparams.py.
+        tx = FlatAdam(self.gradient_clip_val, spec.weight_decay)
         opt_state = tx.init(params)
         repl = replicated_sharding(self.mesh)
         if init_state is not None:
@@ -299,7 +310,7 @@ class Trainer:
             params = jax.tree_util.tree_map(jnp.asarray, init_state[0])
             if init_state[1] is not None:  # None = warm start, fresh optimizer
                 opt_state = restore_opt_state(
-                    jax.device_get(opt_state), init_state[1]
+                    jax.device_get(opt_state), init_state[1], params=params
                 )
         scheduler = PlateauScheduler(spec.learning_rate)
         start_epoch = 0
@@ -328,7 +339,9 @@ class Trainer:
                 self.ckpt_dir, "last"
             )
             params = jax.tree_util.tree_map(jnp.asarray, r_params)
-            opt_state = restore_opt_state(jax.device_get(opt_state), r_opt)
+            opt_state = restore_opt_state(
+                jax.device_get(opt_state), r_opt, params=params
+            )
             start_epoch = int(r_meta.get("epoch", -1)) + 1
             if r_meta.get("best_val") is not None:
                 best_val = float(r_meta["best_val"])
@@ -458,6 +471,23 @@ class Trainer:
                 seed=self.seed,
                 distributed=distributed_run_context(),
             )
+            # Gradient-sync footprint of the flat update path: one collective
+            # per dtype buffer per step (TA206 pins exactly this count in the
+            # lowered HLO; preflight=True re-verifies it on this very mesh),
+            # moving the whole flat gradient. Gauges + an event so `telemetry
+            # summarize` and the bench `detail` report the same numbers.
+            if isinstance(tx, FlatAdam):
+                fspec = flatten_spec(params)
+                n_coll = num_buffers(fspec)
+                sync_bytes = flat_size_bytes(fspec)
+                tel.gauge("train/collectives_per_step").set(n_coll)
+                tel.gauge("train/grad_reduce_bytes").set(sync_bytes)
+                tel.event(
+                    "grad_sync",
+                    collectives_per_step=n_coll,
+                    grad_reduce_bytes=sync_bytes,
+                    flat_buffers=n_coll,
+                )
             epoch_tracker = CompileTracker(hot_fn, size_fn=jit_cache_size)
             eval_tracker = CompileTracker(eval_fn, size_fn=jit_cache_size)
 

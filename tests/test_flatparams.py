@@ -1,0 +1,290 @@
+"""Flat-buffer update path: layout round-trips, BIT-parity vs the optax
+pytree path, packed-window kernel parity, checkpoint portability.
+
+The flat path (train/flatparams.py) exists for one reason — collapsing the
+per-leaf gradient all-reduces into ONE pmean over a contiguous buffer
+(TA206) — and its license to exist is bitwise equivalence: every test here
+asserts exact equality, not tolerances. If a refactor breaks bit parity
+with ``make_optimizer``'s chain, that is a bug in the refactor, not a
+reason to loosen these asserts (the clip-norm reduction order is the only
+numerically delicate part; see _leaf_square_sum).
+"""
+
+import tempfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import masters_thesis_tpu.ops.lstm_kernel as lk
+from masters_thesis_tpu.analysis.traceaudit import (
+    AUDIT_BATCH,
+    AUDIT_FEATURES,
+    AUDIT_LOOKBACK,
+    _synthetic_split,
+    count_step_collectives,
+)
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.parallel import (
+    batch_sharding,
+    global_put,
+    make_data_mesh,
+    replicated_sharding,
+)
+from masters_thesis_tpu.train.checkpoint import (
+    restore_checkpoint,
+    restore_opt_state,
+    save_checkpoint,
+)
+from masters_thesis_tpu.train.flatparams import (
+    FlatAdam,
+    FlatOptState,
+    flat_size_bytes,
+    flatten,
+    flatten_spec,
+    num_buffers,
+    unflatten,
+)
+from masters_thesis_tpu.train.optim import make_optimizer
+from masters_thesis_tpu.train.steps import make_train_epoch
+
+
+def small_spec(**kw) -> ModelSpec:
+    defaults = dict(
+        objective="mse", hidden_size=8, num_layers=2, dropout=0.0,
+        kernel_impl="xla",
+    )
+    defaults.update(kw)
+    return ModelSpec(**defaults)
+
+
+def init_params(spec: ModelSpec, module=None):
+    module = module or spec.build_module()
+    return module.init(
+        jax.random.key(0),
+        jnp.zeros((1, AUDIT_LOOKBACK, AUDIT_FEATURES), jnp.float32),
+    )["params"]
+
+
+class TestLayout:
+    def test_flatten_unflatten_roundtrip_bitwise(self):
+        """unflatten(flatten(t)) == t exactly, mixed dtypes included."""
+        tree = {
+            "dense": {
+                "kernel": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                "bias": jnp.ones((4,), jnp.float32) * 0.5,
+            },
+            "scale": jnp.float32(2.0).reshape(()),
+            "steps": jnp.arange(3, dtype=jnp.int32),
+        }
+        spec = flatten_spec(tree)
+        bufs = flatten(tree, spec)
+        # One 1-D buffer per dtype, sized to the dtype's total elements.
+        assert set(bufs) == {"float32", "int32"}
+        assert bufs["float32"].shape == (12 + 4 + 1,)
+        assert bufs["int32"].shape == (3,)
+        back = unflatten(bufs, spec)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(back)
+        ):
+            assert a.dtype == b.dtype
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_spec_accounting(self):
+        params = init_params(small_spec())
+        spec = flatten_spec(params)
+        n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+        assert num_buffers(spec) == 1  # all-float32 model -> one buffer
+        assert flat_size_bytes(spec) == n * 4
+
+    def test_spec_works_on_shape_structs(self):
+        """flatten_spec needs only shape/dtype — eval_shape trees are enough
+        (bench.py derives grad-sync stats without touching a backend)."""
+        spec_model = small_spec()
+        module = spec_model.build_module()
+        shapes = jax.eval_shape(
+            module.init,
+            jax.random.key(0),
+            jnp.zeros((1, AUDIT_LOOKBACK, AUDIT_FEATURES), jnp.float32),
+        )["params"]
+        concrete = flatten_spec(init_params(spec_model, module))
+        assert flatten_spec(shapes) == concrete
+
+
+class TestFlatVsPytreeParity:
+    """The tentpole contract: the flat epoch program (one fused pmean, one
+    fused Adam pass) is BIT-identical to the per-leaf optax path over a
+    multi-epoch run on the 8-device virtual mesh — with clipping both
+    triggered and untriggered, and weight decay on."""
+
+    def _run_epochs(self, tx, spec, module, split, mesh, n_epochs=3):
+        repl = replicated_sharding(mesh)
+        params = init_params(spec, module)
+        opt_state = tx.init(params)
+        params = global_put(params, repl)
+        opt_state = global_put(opt_state, repl)
+        data = global_put(split, batch_sharding(mesh))
+        fn = make_train_epoch(
+            module, spec.window_objective(), spec.metric_keys, tx, mesh,
+            batch_size=AUDIT_BATCH,
+        )
+        lr = global_put(jnp.float32(1e-2), repl)
+        for e in range(n_epochs):
+            rng = global_put(jax.random.fold_in(jax.random.key(7), e), repl)
+            params, opt_state, sums = fn(params, opt_state, lr, rng, data)
+        return jax.device_get(params), jax.device_get(sums)
+
+    @pytest.mark.parametrize("clip", [0.5, None], ids=["clipped", "unclipped"])
+    def test_three_epoch_bit_parity_8dev(self, clip):
+        assert len(jax.devices()) == 8  # conftest forces the virtual mesh
+        spec = small_spec()
+        mesh = make_data_mesh(None)
+        module = spec.build_module()
+        split = _synthetic_split(
+            mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0)
+        )
+        p_ref, s_ref = self._run_epochs(
+            make_optimizer(clip, spec.weight_decay), spec, module, split, mesh
+        )
+        p_flat, s_flat = self._run_epochs(
+            FlatAdam(clip, spec.weight_decay), spec, module, split, mesh
+        )
+        ref_leaves = jax.tree_util.tree_leaves(p_ref)
+        flat_leaves = jax.tree_util.tree_leaves(p_flat)
+        assert len(ref_leaves) == len(flat_leaves) > 1
+        for a, b in zip(ref_leaves, flat_leaves):
+            assert np.array_equal(np.asarray(a), np.asarray(b))  # bitwise
+        for k in s_ref:
+            assert np.array_equal(s_ref[k][0], s_flat[k][0])
+            assert np.array_equal(s_ref[k][1], s_flat[k][1])
+
+    def test_flat_epoch_has_exactly_one_step_collective(self):
+        """The point of the layout: the compiled epoch's while-body carries
+        ONE all-reduce (the flat gradient pmean) — same count TA206 pins."""
+        spec = small_spec()
+        mesh = make_data_mesh(None)
+        module = spec.build_module()
+        split = _synthetic_split(
+            mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0)
+        )
+        repl = replicated_sharding(mesh)
+        tx = FlatAdam(0.5, spec.weight_decay)
+        params = global_put(init_params(spec, module), repl)
+        opt_state = global_put(tx.init(jax.device_get(params)), repl)
+        data = global_put(split, batch_sharding(mesh))
+        fn = make_train_epoch(
+            module, spec.window_objective(), spec.metric_keys, tx, mesh,
+            batch_size=AUDIT_BATCH,
+        )
+        lowered = fn.lower(
+            params, opt_state, jnp.float32(1e-2), jax.random.key(7), data
+        )
+        assert count_step_collectives(lowered.compile().as_text()) == 1
+
+    def test_pytree_epoch_has_per_leaf_collectives(self):
+        """Control for the TA206 counter: the optax path reduces per leaf,
+        so the same counter must see MORE than one in-loop all-reduce."""
+        spec = small_spec()
+        mesh = make_data_mesh(None)
+        module = spec.build_module()
+        split = _synthetic_split(
+            mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0)
+        )
+        repl = replicated_sharding(mesh)
+        tx = make_optimizer(0.5, spec.weight_decay)
+        params = global_put(init_params(spec, module), repl)
+        opt_state = global_put(tx.init(jax.device_get(params)), repl)
+        data = global_put(split, batch_sharding(mesh))
+        fn = make_train_epoch(
+            module, spec.window_objective(), spec.metric_keys, tx, mesh,
+            batch_size=AUDIT_BATCH,
+        )
+        lowered = fn.lower(
+            params, opt_state, jnp.float32(1e-2), jax.random.key(7), data
+        )
+        n = count_step_collectives(lowered.compile().as_text())
+        assert n == len(jax.tree_util.tree_leaves(jax.device_get(params)))
+        assert n > 1
+
+
+class TestWindowPacking:
+    """VMEM-budgeted multi-window packing (ops/lstm_kernel.py): packing p
+    windows into one program is a pure scheduling change — rows are
+    independent across the batch axis, so packed == serial bitwise."""
+
+    T, B, H, K = 12, 160, 8, 4
+
+    def _inputs(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(
+            rng.standard_normal((self.T, self.B, 4 * self.H)).astype(np.float32)
+        )
+        w = jnp.asarray(
+            rng.standard_normal((self.H, 4 * self.H)).astype(np.float32) * 0.1
+        )
+        return x, w
+
+    def test_pack_width_selection(self):
+        def fits(rows):
+            padded = -(-rows // 8) * 8
+            return padded <= lk.SINGLE_TILE_MAX_ROWS and lk.single_layer_fits(
+                self.T, rows, self.H, 4
+            )
+
+        # 40 windows of 4 rows; the budget admits up to 104 rows -> the
+        # widest divisor of 40 with 4p <= 104 rows is 20 (80 rows).
+        assert lk.window_pack_width(self.B, self.K, fits) == 20
+        # Unschedulable layouts (no window_rows / non-dividing) stay serial.
+        assert lk.window_pack_width(self.B, None, fits) == 1
+        assert lk.window_pack_width(self.B, 3, fits) == 1
+        # A fits predicate that never admits more than one window -> 1.
+        assert lk.window_pack_width(self.B, self.K, lambda rows: False) == 1
+
+    def test_packed_matches_serial_bitwise(self, monkeypatch):
+        x, w = self._inputs()
+        packed = lk.lstm_recurrence(x, w, impl="interpret", window_rows=self.K)
+        monkeypatch.setattr(lk, "window_pack_width", lambda *a, **k: 1)
+        serial = lk.lstm_recurrence(x, w, impl="interpret", window_rows=self.K)
+        assert jnp.array_equal(packed, serial)
+
+    def test_packed_matches_xla_reference(self):
+        x, w = self._inputs()
+        packed = lk.lstm_recurrence(x, w, impl="interpret", window_rows=self.K)
+        xla = lk.lstm_recurrence(x, w, impl="xla")
+        assert np.allclose(np.asarray(packed), np.asarray(xla), atol=1e-5)
+
+
+class TestCheckpointPortability:
+    def test_flat_opt_state_roundtrip_bitwise(self):
+        """Checkpoints store moments UNFLATTENED (params-shaped pytrees) so
+        the on-disk layout survives flat-buffer layout changes; restore
+        re-flattens against current params. Moments must round-trip
+        bitwise, count must stay int32."""
+        spec = small_spec()
+        params = init_params(spec)
+        tx = FlatAdam(0.5, spec.weight_decay)
+        state = tx.init(params)
+        fs = flatten_spec(params)
+        grads = {k: jnp.full_like(v, 0.25) for k, v in flatten(params, fs).items()}
+        _, state = tx.update_flat(grads, state, flatten(params, fs), fs)
+        with tempfile.TemporaryDirectory() as d:
+            save_checkpoint(Path(d), "last", params, state, spec, {"epoch": 0})
+            r_params, r_opt, _, _ = restore_checkpoint(Path(d), "last")
+            template = jax.device_get(tx.init(params))
+            restored = restore_opt_state(template, r_opt, params=r_params)
+        assert isinstance(restored, FlatOptState)
+        assert restored.count.dtype == jnp.int32
+        assert int(restored.count) == 1
+        for moment, ref in (("mu", state.mu), ("nu", state.nu)):
+            got = getattr(restored, moment)
+            assert set(got) == set(ref)
+            for k in ref:
+                assert np.array_equal(np.asarray(got[k]), np.asarray(ref[k]))
+
+    def test_restore_without_params_refuses(self):
+        params = init_params(small_spec())
+        template = FlatAdam(None, 0.0).init(params)
+        with pytest.raises(ValueError, match="params"):
+            restore_opt_state(template, {"count": 0}, params=None)

@@ -17,7 +17,9 @@ else
 fi
 
 # 2. tracelint: AST lint over the package + trace-time audit on the
-#    hermetic 8-device virtual CPU mesh.
+#    hermetic 8-device virtual CPU mesh (includes TA206: the compiled
+#    train step carries exactly ONE cross-replica reduction — the flat
+#    gradient pmean).
 echo "== tracelint =="
 JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
 
