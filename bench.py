@@ -76,36 +76,27 @@ def _probe_cache_path() -> Path:
     return Path(__file__).resolve().parent / "results" / "probe_cache.json"
 
 
-def _read_probe_cache() -> dict | None:
-    """Last probe outcome, or None when absent/corrupt/expired."""
-    try:
-        cached = json.loads(_probe_cache_path().read_text())
-    except (OSError, json.JSONDecodeError):
-        return None
-    if not isinstance(cached, dict):
-        return None
-    at = cached.get("at")
-    if not isinstance(at, (int, float)) or time.time() - at > PROBE_CACHE_TTL_S:
-        return None
-    return cached
+def _backend_health():
+    """The shared probe-cache/wedge policy, pinned to bench's knobs.
+
+    The implementation lives in utils.backend_probe.BackendHealth so the
+    resilience supervisor applies the identical policy; bench keeps its
+    constants and cache location (results/probe_cache.json) unchanged.
+    """
+    from masters_thesis_tpu.utils import BackendHealth
+
+    return BackendHealth(
+        _probe_cache_path(),
+        ttl_s=PROBE_CACHE_TTL_S,
+        timeout_s=PROBE_TIMEOUT_S,
+        budget_s=PROBE_BUDGET_S,
+        backoff_s=PROBE_BACKOFF_S,
+    )
 
 
 def _write_probe_cache(ok: bool, detail: str) -> None:
     """Best-effort: the cache must never cost the run its JSON line."""
-    try:
-        from masters_thesis_tpu.utils import atomic_write_text
-
-        path = _probe_cache_path()
-        path.parent.mkdir(parents=True, exist_ok=True)
-        atomic_write_text(
-            path,
-            json.dumps(
-                {"ok": ok, "at": time.time(), "detail": detail[-500:]},
-                indent=2,
-            ),
-        )
-    except OSError:
-        pass
+    _backend_health().record(ok, detail)
 
 # Scaled-down sample count (100k vs the reference's 1M bootstrap) keeps the
 # bench wall-clock to a couple of minutes; per-step work is IDENTICAL to the
@@ -116,47 +107,24 @@ MEASURE_EPOCHS = 8
 
 
 def _ensure_responsive_backend() -> tuple[bool, int]:
-    """Probe TPU init with retries; returns (degraded_to_cpu, attempts)."""
-    from masters_thesis_tpu.utils import probe_tpu_backend
+    """Probe TPU init with retries; returns (degraded_to_cpu, attempts).
 
-    cached = _read_probe_cache()
-    known_wedged = cached is not None and not cached.get("ok")
-    if known_wedged:
-        # The cache says the lease was wedged minutes ago: ONE attempt
-        # (budget_s=0 -> no retries), then fail over to CPU on its first
-        # timeout instead of re-burning the 600s retry budget.
-        print(
-            "probe cache says lease was wedged "
-            f"{time.time() - cached['at']:.0f}s ago; single probe attempt",
-            file=sys.stderr,
-        )
-        budget_s = 0.0
-    else:
-        budget_s = PROBE_BUDGET_S
-    probe = probe_tpu_backend(
-        timeout_s=PROBE_TIMEOUT_S,
-        budget_s=budget_s,
-        backoff_s=PROBE_BACKOFF_S,
-    )
-    _write_probe_cache(probe.ok, probe.detail or "")
-    if probe.ok:
-        return False, probe.attempts
-    print(
-        f"device probe failed {probe.attempts}x over {budget_s:.0f}s "
-        f"({probe.detail}); falling back to CPU backend",
-        file=sys.stderr,
-    )
+    Known-wedged leases (probe cache within TTL) get a single attempt
+    instead of the full 600s budget — the policy lives in BackendHealth.
+    """
+    health = _backend_health().ensure_responsive()
+    if health.ok:
+        return False, health.attempts
+    print("falling back to CPU backend", file=sys.stderr)
     _pin_cpu_in_process()
-    return True, probe.attempts
+    return True, health.attempts
 
 
 def _pin_cpu(env: dict) -> dict:
-    """The one CPU-pinning incantation: JAX_PLATFORMS alone is NOT enough —
-    the relay plugin trigger env must go too or the axon sitecustomize
-    re-selects the TPU plugin regardless (ADVICE r4)."""
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    return env
+    """See utils.backend_probe.pin_cpu (relay plugin env + platform pin)."""
+    from masters_thesis_tpu.utils.backend_probe import pin_cpu
+
+    return pin_cpu(env)
 
 
 def _pin_cpu_in_process() -> None:

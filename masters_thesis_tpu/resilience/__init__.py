@@ -1,0 +1,40 @@
+"""Resilient training: deterministic fault injection + run supervision.
+
+Two halves, one contract:
+
+- :mod:`masters_thesis_tpu.resilience.faults` — a seeded, explicitly
+  activated fault-injection harness (preempt/kill/hang/wedge/corrupt/nan)
+  wired into host-side points of the trainer, checkpoint, probe, and data
+  code. Off by default; never reachable from traced code.
+- :mod:`masters_thesis_tpu.resilience.supervisor` — a self-healing run
+  supervisor (``python -m masters_thesis_tpu.resilience run -- <cmd>``)
+  that wraps training as a child process: resume-from-last-good, retry
+  with exponential backoff under a budget, evidence-based failure
+  classification (transient vs deterministic), divergence rollback with
+  optional LR halving, and graceful CPU degradation on a wedged backend.
+
+This package (like the telemetry CLIs) is jax-free by contract: the
+supervisor must work exactly when the accelerator runtime is wedged.
+"""
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.faults import FaultInjected, FaultPlan, FaultSpec
+
+__all__ = [
+    "faults",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "RunSupervisor",
+    "SupervisorConfig",
+]
+
+
+def __getattr__(name: str):
+    # Lazy: keep `import masters_thesis_tpu.resilience` cheap for the
+    # fault-point fast path inside the trainer hot loop.
+    if name in ("RunSupervisor", "SupervisorConfig", "SupervisorResult"):
+        from masters_thesis_tpu.resilience import supervisor
+
+        return getattr(supervisor, name)
+    raise AttributeError(name)

@@ -1,0 +1,230 @@
+"""Deterministic fault injection for chaos-testing the training stack.
+
+A :class:`FaultPlan` is a seeded list of :class:`FaultSpec` entries, each
+naming a *fault point* (a host-side call site instrumented with
+:func:`fire`), a fault *kind*, and match conditions. Plans are activated
+only explicitly — via the ``MTT_FAULT_PLAN`` environment variable (JSON,
+or ``@/path/to/plan.json``) or :func:`install_plan` in-process — so the
+default-off cost is a single dict lookup per fault point and nothing else.
+
+Every fault point lives strictly in host code (epoch-loop boundaries,
+checkpoint publish, the probe subprocess driver, metric readback): no
+point is reachable from traced/jitted code, so an active plan cannot
+change the compiled step HLO and tracelint/TA201–TA206 stay green by
+construction.
+
+Kinds:
+
+- ``preempt`` — SIGTERM self (the flight recorder's handler dumps a
+  crashdump on the way down, exactly like a real preemption notice).
+- ``kill``    — SIGKILL self: no handler runs, heartbeat goes stale.
+- ``hang``    — stop making progress (sleep forever); exercises hang
+  watchdogs and supervisor heartbeat-staleness detection.
+- ``raise``   — raise :class:`FaultInjected` (a crashing bug stand-in).
+- ``wedge``   — returned to the caller: the backend probe treats the
+  attempt as a simulated ``jax.devices()`` timeout (wedged lease).
+- ``corrupt`` — returned to the caller: checkpoint code flips bytes in
+  the just-published tree (seeded, deterministic).
+- ``nan``     — returned to the caller: the trainer poisons the host-side
+  loss readback with NaN, triggering the divergence halt.
+
+Match semantics: a spec fires when its ``point`` matches, the current
+supervisor attempt (``MTT_ATTEMPT``, default 1) equals ``attempt``
+(``null`` = any attempt), and every ``match`` key equals the
+corresponding ``fire(**ctx)`` value. Attempt scoping is what keeps chaos
+runs convergent: a kill-at-epoch-3 fault fires on attempt 1 and stays
+quiet after the supervisor resumes the run as attempt 2.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+FAULT_PLAN_ENV = "MTT_FAULT_PLAN"
+ATTEMPT_ENV = "MTT_ATTEMPT"
+
+KINDS = frozenset(
+    {"preempt", "kill", "hang", "raise", "wedge", "corrupt", "nan"}
+)
+#: Kinds fire() executes itself (the process never returns normally).
+PROCESS_KINDS = frozenset({"preempt", "kill", "hang", "raise"})
+#: Kinds returned to the call site, which applies the corruption itself.
+DATA_KINDS = KINDS - PROCESS_KINDS
+
+#: Known fault points (documentation + parse-time typo guard). Each is a
+#: host-side call site; see docs/resilience.md for where they sit.
+POINTS = frozenset(
+    {
+        "trainer.epoch_start",  # top of the epoch loop, before dispatch
+        "trainer.epoch_dispatched",  # after dispatch, before readback/save
+        "trainer.loss",  # host-side metric readback (kind: nan)
+        "data.epoch",  # host data plane, once per epoch stream
+        "checkpoint.pre_publish",  # staged pair complete, not yet live
+        "checkpoint.post_publish",  # after publish (kind: corrupt)
+        "probe.attempt",  # backend probe attempt (kind: wedge)
+        "worker.epoch",  # jax-free selfcheck worker epochs
+    }
+)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by ``kind: raise`` faults — a deterministic crashing bug."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    point: str
+    kind: str
+    #: Supervisor attempt this spec is scoped to (None = every attempt).
+    attempt: int | None = 1
+    #: Context equality constraints, e.g. ``{"epoch": 3}``.
+    match: Mapping[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind: {self.kind!r}")
+        if self.point not in POINTS:
+            raise ValueError(f"unknown fault point: {self.point!r}")
+
+    def matches(self, point: str, attempt: int, ctx: Mapping[str, Any]) -> bool:
+        if point != self.point:
+            return False
+        if self.attempt is not None and attempt != self.attempt:
+            return False
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+
+@dataclass
+class FaultPlan:
+    faults: list[FaultSpec]
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """Parse a plan from JSON text or ``@/path`` file reference."""
+        text = text.strip()
+        if text.startswith("@"):
+            text = Path(text[1:]).read_text()
+        raw = json.loads(text)
+        if isinstance(raw, list):
+            raw = {"faults": raw}
+        faults = [FaultSpec(**{**f}) for f in raw.get("faults", [])]
+        return cls(faults=faults, seed=int(raw.get("seed", 0)))
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "faults": [
+                    {
+                        "point": f.point,
+                        "kind": f.kind,
+                        "attempt": f.attempt,
+                        "match": dict(f.match),
+                    }
+                    for f in self.faults
+                ],
+            }
+        )
+
+    def lookup(
+        self, point: str, attempt: int, ctx: Mapping[str, Any]
+    ) -> FaultSpec | None:
+        for spec in self.faults:
+            if spec.matches(point, attempt, ctx):
+                return spec
+        return None
+
+
+# In-process override installed by tests; _UNSET means "use the env".
+_UNSET = object()
+_override: Any = _UNSET
+# Env-parse cache keyed by the raw env text, so repeated fire() calls
+# don't re-parse and a changed env (new subprocess plan) is picked up.
+_env_cache: tuple[str, FaultPlan] | None = None
+
+
+def install_plan(plan: FaultPlan | None) -> None:
+    """Install a plan in-process, taking precedence over
+    ``MTT_FAULT_PLAN`` (``None`` forces injection off even if the env is
+    set). :func:`clear_plan` falls back to the environment again."""
+    global _override
+    _override = plan
+
+
+def clear_plan() -> None:
+    global _override
+    _override = _UNSET
+
+
+def active_plan() -> FaultPlan | None:
+    global _env_cache
+    if _override is not _UNSET:
+        return _override
+    text = os.environ.get(FAULT_PLAN_ENV)
+    if not text:
+        return None
+    if _env_cache is None or _env_cache[0] != text:
+        _env_cache = (text, FaultPlan.parse(text))
+    return _env_cache[1]
+
+
+def current_attempt() -> int:
+    try:
+        return int(os.environ.get(ATTEMPT_ENV, "1") or 1)
+    except ValueError:
+        return 1
+
+
+def fire(point: str, **ctx: Any) -> str | None:
+    """Fire any fault armed at ``point`` for the current attempt/context.
+
+    Process kinds (preempt/kill/hang/raise) never return. Data kinds
+    (nan/wedge/corrupt) return the kind string for the call site to
+    apply; returns ``None`` (the overwhelmingly common case) when no
+    plan is active or nothing matches.
+    """
+    if _override is _UNSET and FAULT_PLAN_ENV not in os.environ:
+        return None  # fast path: injection disabled
+    plan = active_plan()
+    if plan is None:
+        return None
+    spec = plan.lookup(point, current_attempt(), ctx)
+    if spec is None:
+        return None
+    print(
+        f"[faults] firing kind={spec.kind} at point={point} "
+        f"attempt={current_attempt()} ctx={ctx}",
+        file=sys.stderr,
+        flush=True,
+    )
+    if spec.kind == "preempt":
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Give a SIGTERM handler (flight recorder dump + re-delivery) time
+        # to run; if none is installed the default action already killed us.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+        os._exit(143)
+    if spec.kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # unreachable; SIGKILL is not deliverable-later
+    if spec.kind == "hang":
+        while True:
+            time.sleep(1.0)
+    if spec.kind == "raise":
+        raise FaultInjected(f"injected crash at {point} (ctx={ctx})")
+    return spec.kind
+
+
+def corruption_seed(extra: int = 0) -> int:
+    """Deterministic seed for data-kind corruption at a call site."""
+    plan = active_plan()
+    return (plan.seed if plan is not None else 0) * 1_000_003 + extra

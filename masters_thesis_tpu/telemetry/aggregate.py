@@ -84,7 +84,23 @@ def digest_stream(path: Path, root: Path) -> dict:
             nproc = max(nproc or 0, ev["nproc"])
         host = host or ev.get("host")
         pid = pid or ev.get("pid")
-    started = bool(by_kind.get("run_started"))
+    starts = by_kind.get("run_started", [])
+    started = bool(starts)
+    # Attempt linking: a supervised run APPENDS each retry to the same
+    # stream, so one events.jsonl can hold several attempts — delimited by
+    # run_started (trainer streams) or the envelope's attempt tag. The
+    # digest folds them into ONE logical run: `finished` reflects the LAST
+    # attempt, and a crashdump from a superseded attempt doesn't fail a
+    # stream whose final attempt completed.
+    attempts = max(
+        len(starts),
+        len(by_kind.get("attempt_started", [])),
+        max((int(ev.get("attempt") or 1) for ev in events), default=0),
+    )
+    resumed_from = next(
+        (s["resumed_from"] for s in reversed(starts) if s.get("resumed_from")),
+        None,
+    )
     finished = (by_kind.get("run_finished") or [None])[-1]
     epochs = by_kind.get("epoch", [])
     epoch_walls: dict[int, float] = {}
@@ -113,6 +129,8 @@ def digest_stream(path: Path, root: Path) -> dict:
         "run": events[0].get("run") if events else None,
         "events": len(events),
         "started": started,
+        "attempts": attempts,
+        "resumed_from": resumed_from,
         "finished": finished is not None,
         "diverged": bool(finished and finished.get("diverged")),
         "steps_per_sec": finished.get("steps_per_sec") if finished else None,
@@ -147,7 +165,17 @@ def _last_activity(d: dict) -> float | None:
 def _status(d: dict, now: float, grace_s: float) -> str:
     if d["finished"]:
         return "finished"
+    last = _last_activity(d)
     crash = d.get("crashdump")
+    if (
+        crash
+        and (d.get("attempts") or 1) > 1
+        and last is not None
+        and (now - last) <= grace_s
+    ):
+        # The crashdump belongs to a superseded attempt; the retry is
+        # still making progress.
+        return "running"
     if crash and crash.get("reason"):
         reason = str(crash["reason"])
         if reason.startswith("signal"):
@@ -378,6 +406,12 @@ def render_fleet_text(report: dict, postmortem: bool = False) -> str:
             f"last_epoch={_fmt(d['last_epoch'], 'd') if d['last_epoch'] is not None else 'n/a'} "
             f"sps={_fmt(d['steps_per_sec'], '.2f')} "
             f"gap={_fmt(hb, '.1f')}s"
+            + (
+                f" attempts={d['attempts']}"
+                + (" (resumed)" if d.get("resumed_from") else "")
+                if (d.get("attempts") or 1) > 1
+                else ""
+            )
         )
     skew = report["epoch_skew"]
     lines.append(

@@ -21,7 +21,23 @@ import time
 from pathlib import Path
 
 # Envelope keys; payload keys must not collide (enforced at emit time).
-RESERVED_KEYS = ("ts", "kind", "run", "seq", "host", "pid", "proc", "nproc")
+RESERVED_KEYS = (
+    "ts", "kind", "run", "seq", "host", "pid", "proc", "nproc", "attempt",
+)
+
+
+def current_attempt() -> int:
+    """Supervisor attempt number (``MTT_ATTEMPT``); 1 when unsupervised.
+
+    The resilience supervisor exports the env for each child launch so
+    every event a resumed run appends to the shared stream is tagged with
+    which attempt produced it — that is what lets summarize/postmortem
+    link attempts into one logical run.
+    """
+    try:
+        return int(os.environ.get("MTT_ATTEMPT", "1") or 1)
+    except ValueError:
+        return 1
 
 
 class EventSink:
@@ -33,12 +49,14 @@ class EventSink:
         run_id: str,
         proc: int | None = None,
         nproc: int | None = None,
+        attempt: int | None = None,
     ):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.run_id = run_id
         self.proc = proc
         self.nproc = nproc
+        self.attempt = current_attempt() if attempt is None else attempt
         self._host = socket.gethostname()
         self._pid = os.getpid()
         self._seq = 0
@@ -59,6 +77,7 @@ class EventSink:
                 "pid": self._pid,
                 "proc": self.proc,
                 "nproc": self.nproc,
+                "attempt": self.attempt,
                 **payload,
             }
             self._seq += 1

@@ -120,6 +120,8 @@ def summarize_events(events: list[dict]) -> dict:
         None,
     )
 
+    restarts = _restart_stats(events, by_kind)
+
     preflight = (by_kind.get("preflight") or [{}])[-1]
     # Gradient-sync footprint (flat update path, train/flatparams.py): the
     # trainer records one grad_sync event per run — collectives per step
@@ -175,6 +177,7 @@ def summarize_events(events: list[dict]) -> dict:
             "grad_reduce_bytes": grad_sync.get("grad_reduce_bytes"),
             "flat_buffers": grad_sync.get("flat_buffers"),
         },
+        "restarts": restarts,
         "preflight": preflight.get("status"),
         "diverged": finished.get("diverged"),
         "profile_windows": profile_windows,
@@ -182,6 +185,61 @@ def summarize_events(events: list[dict]) -> dict:
     }
     report["violations"] = contract_violations(report)
     return report
+
+
+def _restart_stats(events: list[dict], by_kind: dict) -> dict:
+    """Restart accounting over a (possibly multi-attempt) event stream.
+
+    A resumed run APPENDS to the same events.jsonl (telemetry/run.py), so
+    one stream can hold several attempts: trainer streams delimit them
+    with run_started, supervisor streams with attempt_started. Lost work
+    per dead attempt = gap between its last activity and its last
+    checkpoint_saved (no checkpoint in the segment -> the whole segment
+    was lost); supervisor attempt_finished events carry the figure
+    precomputed.
+    """
+    starts = by_kind.get("run_started", [])
+    sup_started = by_kind.get("attempt_started", [])
+    attempts = max(len(starts), len(sup_started), 1 if events else 0)
+
+    lost_work_s = 0.0
+    # Supervisor streams: attempt_finished carries lost_work_s directly.
+    measured = False
+    for ev in by_kind.get("attempt_finished", []):
+        if ev.get("lost_work_s") is not None and not ev.get("ok"):
+            lost_work_s += float(ev["lost_work_s"])
+            measured = True
+    if not measured and len(starts) > 1:
+        # Trainer streams: split into segments at each run_started; a
+        # segment without a run_finished died mid-flight.
+        segments: list[list[dict]] = []
+        for ev in events:
+            if ev.get("kind") == "run_started":
+                segments.append([])
+            if segments:
+                segments[-1].append(ev)
+        for seg in segments:
+            if any(e.get("kind") == "run_finished" for e in seg):
+                continue
+            last_ts = max((e.get("ts") or 0.0) for e in seg)
+            saves = [
+                e.get("ts") or 0.0
+                for e in seg
+                if e.get("kind") == "checkpoint_saved"
+            ]
+            floor_ts = max(saves) if saves else min(
+                (e.get("ts") or 0.0) for e in seg
+            )
+            lost_work_s += max(0.0, last_ts - floor_ts)
+
+    return {
+        "attempts": attempts,
+        "restarts": max(0, attempts - 1),
+        "lost_work_s": lost_work_s,
+        "degradations": len(by_kind.get("degradation", [])),
+        "rollbacks": len(by_kind.get("rollback", [])),
+        "resumed": any(e.get("resumed_from") for e in starts),
+    }
 
 
 def contract_violations(report: dict) -> list[str]:
@@ -253,6 +311,7 @@ def render_text(report: dict) -> str:
         f"device memory  : peak {_fmt_bytes(mem['peak_bytes'])} "
         f"(live buffers {_fmt_bytes(mem['live_buffer_bytes'])}, "
         f"source: {mem['source'] or 'n/a'})",
+        _render_restarts(report.get("restarts") or {}),
         f"preflight      : {report.get('preflight') or 'not recorded'}",
     ]
     gs = report.get("grad_sync") or {}
@@ -274,6 +333,21 @@ def render_text(report: dict) -> str:
     else:
         lines.append("contracts      : ok")
     return "\n".join(lines)
+
+
+def _render_restarts(r: dict) -> str:
+    if not r or (
+        not r.get("restarts")
+        and not r.get("degradations")
+        and not r.get("rollbacks")
+    ):
+        return "restarts       : none"
+    parts = [f"{r.get('restarts', 0)} ({r.get('attempts', 1)} attempts)"]
+    parts.append(f"lost work {_fmt(r.get('lost_work_s'), '.1f')}s")
+    if r.get("rollbacks"):
+        parts.append(f"{r['rollbacks']} rollback(s)")
+    parts.append(f"{r.get('degradations', 0)} degradation event(s)")
+    return "restarts       : " + ", ".join(parts)
 
 
 def render_json(report: dict) -> str:

@@ -6,9 +6,10 @@ src/model.py:188 + test.py:177-178 reload a module from checkpoint with its
 constructor hparams). Layout::
 
     <ckpt_dir>/
-      best/   # orbax pytree: params, opt_state
+      best/   # orbax pytree: params, opt_state (+ MANIFEST.json checksums)
       last/
       best.json / last.json   # hparams + training metadata sidecar
+      last.prev/ + last.prev.json   # previous good save (restore fallback)
 
 Orbax handles multi-host coordination and HBM->host streaming natively;
 the JSON sidecar carries everything needed to rebuild the ModelSpec and
@@ -19,8 +20,10 @@ equivalent).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import shutil
+import sys
 from pathlib import Path
 from typing import Any
 
@@ -30,8 +33,18 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.resilience import faults
 from masters_thesis_tpu.train import flatparams
 from masters_thesis_tpu.utils import atomic_write_text
+
+#: Content-checksum manifest written INSIDE the checkpoint tree, so it
+#: rides the same staged-swap renames as the data it describes.
+MANIFEST_NAME = "MANIFEST.json"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """No restorable checkpoint: latest (and any previous-good fallback)
+    failed content verification."""
 
 
 def save_checkpoint(
@@ -50,9 +63,11 @@ def save_checkpoint(
     # <tag> before the new write was durable — a SIGKILL mid-save then
     # destroyed the only resume point, caught by the CLI kill-test):
     #   1. orbax tree  -> <tag>.new        (complete before anything moves)
-    #   2. sidecar     -> <tag>.json.new   (meta matching the staged tree)
-    #   3. publish, renames only:  <tag> -> <tag>.old,  <tag>.new -> <tag>,
-    #      <tag>.json.new -> <tag>.json,  then best-effort rm <tag>.old
+    #   2. MANIFEST.json (sha256 per file, fsync'd) inside the staged tree
+    #   3. sidecar     -> <tag>.json.new   (meta matching the staged tree)
+    #   4. publish, renames only:  <tag> -> <tag>.prev (kept as the
+    #      previous-good fallback),  <tag>.new -> <tag>,
+    #      <tag>.json.new -> <tag>.json
     # A kill at ANY point leaves either the previous checkpoint intact or
     # a staged pair that _recover_staged finishes on the next restore;
     # the sidecar rides the same swap so tree and meta can never pair up
@@ -91,24 +106,103 @@ def save_checkpoint(
         )
         ckptr.wait_until_finished()
     if jax.process_index() == 0:
+        # Content checksums INSIDE the staged tree: the manifest travels
+        # through the publish renames with the data it describes, so a
+        # torn or bit-flipped tree is detectable at restore time and can
+        # never silently pair with a clean manifest from another save.
+        _write_manifest(staging)
         sidecar = {"spec": dataclasses.asdict(spec), "meta": meta}
-        atomic_write_text(staged_sidecar, json.dumps(sidecar, indent=2))
+        atomic_write_text(
+            staged_sidecar, json.dumps(sidecar, indent=2), fsync=True
+        )
+        faults.fire("checkpoint.pre_publish", tag=tag)
         _publish(ckpt_dir, tag)
+        if faults.fire("checkpoint.post_publish", tag=tag) == "corrupt":
+            _corrupt_tree(path, seed=faults.corruption_seed())
+
+
+def _write_manifest(tree: Path) -> None:
+    """Write ``MANIFEST.json`` (sha256 + size per file) into ``tree``,
+    fsync'ing so the checksums are durable before the publish rename."""
+    files = {}
+    for p in sorted(tree.rglob("*")):
+        if p.is_file() and p.name != MANIFEST_NAME:
+            files[str(p.relative_to(tree))] = {
+                "sha256": hashlib.sha256(p.read_bytes()).hexdigest(),
+                "size": p.stat().st_size,
+            }
+    atomic_write_text(
+        tree / MANIFEST_NAME,
+        json.dumps({"algo": "sha256", "files": files}, indent=2),
+        fsync=True,
+    )
+
+
+def verify_checkpoint(path: Path) -> bool:
+    """Check a checkpoint tree against its content manifest.
+
+    Trees without a manifest (pre-manifest checkpoints) verify True —
+    backward compatible, no protection. A manifest whose files are
+    missing, truncated, or checksum-mismatched fails.
+    """
+    path = Path(path)
+    manifest_path = path / MANIFEST_NAME
+    if not manifest_path.exists():
+        return path.exists()
+    try:
+        manifest = json.loads(manifest_path.read_text())
+        for rel, want in manifest["files"].items():
+            p = path / rel
+            if not p.is_file() or p.stat().st_size != want["size"]:
+                return False
+            if hashlib.sha256(p.read_bytes()).hexdigest() != want["sha256"]:
+                return False
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    return True
+
+
+def _corrupt_tree(path: Path, seed: int) -> None:
+    """Deterministically flip one byte in the largest data file of a
+    checkpoint tree (fault-injection helper for ``kind: corrupt``)."""
+    import random
+
+    files = sorted(
+        (p for p in Path(path).rglob("*") if p.is_file() and p.name != MANIFEST_NAME),
+        key=lambda p: (-p.stat().st_size, str(p)),
+    )
+    if not files:
+        return
+    target = files[0]
+    data = bytearray(target.read_bytes())
+    if not data:
+        return
+    idx = random.Random(seed).randrange(len(data))
+    data[idx] ^= 0xFF
+    target.write_bytes(bytes(data))
 
 
 def _publish(ckpt_dir: Path, tag: str) -> None:
-    """Swap a complete staged pair into place. Renames only (atomic); the
-    old tree is moved aside first and deleted last, best-effort. Shared by
-    save_checkpoint and crash recovery so the ordering can't diverge."""
+    """Swap a complete staged pair into place. Renames only (atomic);
+    shared by save_checkpoint and crash recovery so the ordering can't
+    diverge. The outgoing checkpoint is ROTATED to ``<tag>.prev`` (tree +
+    sidecar) instead of deleted: restore falls back to it when the latest
+    tree fails content verification. A crash mid-rotation can at worst
+    leave an incomplete ``.prev`` pair — never a damaged primary, since
+    recovery re-runs the staging swap."""
     path = ckpt_dir / tag
-    old = ckpt_dir / f"{tag}.old"
-    if old.exists():
-        shutil.rmtree(old)
+    prev = ckpt_dir / f"{tag}.prev"
+    prev_sidecar = ckpt_dir / f"{tag}.prev.json"
     if path.exists():
-        path.rename(old)
+        if prev.exists():
+            shutil.rmtree(prev)
+        prev_sidecar.unlink(missing_ok=True)
+        path.rename(prev)
+        sidecar = ckpt_dir / f"{tag}.json"
+        if sidecar.exists():
+            sidecar.replace(prev_sidecar)
     (ckpt_dir / f"{tag}.new").rename(path)
     (ckpt_dir / f"{tag}.json.new").replace(ckpt_dir / f"{tag}.json")
-    shutil.rmtree(old, ignore_errors=True)
 
 
 def _recover_staged(ckpt_dir: Path, tag: str) -> None:
@@ -161,13 +255,30 @@ def _run_recovery(ckpt_dir: Path, tag: str) -> None:
         )
 
 
+def _candidates(ckpt_dir: Path, tag: str) -> list[tuple[Path, Path]]:
+    """(tree, sidecar) pairs in restore-preference order: latest, then
+    the previous-good rotation."""
+    return [
+        (ckpt_dir / tag, ckpt_dir / f"{tag}.json"),
+        (ckpt_dir / f"{tag}.prev", ckpt_dir / f"{tag}.prev.json"),
+    ]
+
+
+def _pick_restorable(ckpt_dir: Path, tag: str) -> tuple[Path, Path] | None:
+    for tree, sidecar in _candidates(ckpt_dir, tag):
+        if tree.exists() and sidecar.exists() and verify_checkpoint(tree):
+            return tree, sidecar
+    return None
+
+
 def checkpoint_restorable(ckpt_dir: Path, tag: str) -> bool:
-    """True if ``<ckpt_dir>/<tag>`` (tree + sidecar) can be restored,
-    after finishing any interrupted staging swap."""
+    """True if ``<ckpt_dir>/<tag>`` — or its ``.prev`` previous-good
+    rotation — verifies and can be restored, after finishing any
+    interrupted staging swap."""
     ckpt_dir = Path(ckpt_dir)
     if ckpt_dir.exists():
         _run_recovery(ckpt_dir, tag)
-    return (ckpt_dir / tag).exists() and (ckpt_dir / f"{tag}.json").exists()
+    return _pick_restorable(ckpt_dir, tag) is not None
 
 
 def restore_checkpoint(
@@ -183,16 +294,36 @@ def restore_checkpoint(
     # Recovery must look where the staging artifacts actually live: next
     # to <tag> under a checkpoint ROOT, or next to the direct path itself
     # (a direct path may not even exist yet if the kill landed mid-swap).
-    if (ckpt_dir / tag).exists() or (ckpt_dir / f"{tag}.new").exists():
+    if any(
+        (ckpt_dir / n).exists() for n in (tag, f"{tag}.new", f"{tag}.prev")
+    ):
         _run_recovery(ckpt_dir, tag)
-    elif ckpt_dir.parent.exists():
-        _run_recovery(ckpt_dir.parent, ckpt_dir.name)
-    if (ckpt_dir / tag).exists():
-        path = ckpt_dir / tag
-        sidecar_path = ckpt_dir / f"{tag}.json"
+        root, name = ckpt_dir, tag
     else:
-        path = ckpt_dir
-        sidecar_path = ckpt_dir.parent / f"{ckpt_dir.name}.json"
+        if ckpt_dir.parent.exists():
+            _run_recovery(ckpt_dir.parent, ckpt_dir.name)
+        root, name = ckpt_dir.parent, ckpt_dir.name
+    # Content verification with previous-good fallback: a torn or
+    # bit-flipped latest tree (detected via its MANIFEST.json) must not
+    # end the run when the ``.prev`` rotation still holds a good save.
+    chosen = _pick_restorable(root, name)
+    if chosen is None:
+        primary, primary_sidecar = _candidates(root, name)[0]
+        if primary.exists() and primary_sidecar.exists():
+            raise CorruptCheckpointError(
+                f"checkpoint {primary} failed content verification and no "
+                f"previous-good fallback ({primary}.prev) is restorable"
+            )
+        # Preserve the legacy missing-checkpoint error shape.
+        raise FileNotFoundError(f"no checkpoint at {primary}")
+    path, sidecar_path = chosen
+    if path.name.endswith(".prev"):
+        print(
+            f"[checkpoint] latest {root / name} failed verification; "
+            f"restoring previous good {path}",
+            file=sys.stderr,
+            flush=True,
+        )
     sidecar = json.loads(sidecar_path.read_text())
     with ocp.StandardCheckpointer() as ckptr:
         tree = ckptr.restore(path)

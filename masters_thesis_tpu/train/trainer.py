@@ -22,6 +22,7 @@ Two epoch modes:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from pathlib import Path
 from typing import Any
@@ -40,6 +41,7 @@ from masters_thesis_tpu.parallel import (
     global_put,
     make_data_mesh,
 )
+from masters_thesis_tpu.resilience import faults
 from masters_thesis_tpu.telemetry import (
     CompileTracker,
     EpochRecorder,
@@ -104,10 +106,11 @@ class Trainer:
         ckpt_dir: str | Path | None = None,
         seed: int = 0,
         name: str = "fast",
-        resume: bool = False,
+        resume: bool | str = False,
         preflight: bool = False,
         telemetry: TelemetryRun | str | Path | None = None,
         hang_timeout_s: float | None = None,
+        checkpoint_every_n_epochs: int | None = None,
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
@@ -139,7 +142,20 @@ class Trainer:
         self.ckpt_dir = Path(ckpt_dir) if ckpt_dir else None
         self.seed = seed
         self.name = name
+        # 'auto' (the supervised-run setting) and plain True both mean
+        # "continue from <ckpt_dir>/last when it is restorable".
+        if isinstance(resume, str):
+            resume = resume.lower() in ("true", "auto", "1", "yes")
         self.resume = resume
+        # Epoch-granular auto-checkpointing for supervised runs: every N
+        # epochs, 'last' is refreshed behind a fence (after the divergence
+        # check, so poisoned params never overwrite a good save). None
+        # keeps the legacy cadence (val epochs + end of fit only).
+        self.checkpoint_every_n_epochs = (
+            max(1, int(checkpoint_every_n_epochs))
+            if checkpoint_every_n_epochs
+            else None
+        )
         # Run the tracelint trace-time audit (analysis.traceaudit) on this
         # trainer's mesh before fitting: recompile stability, transfer
         # guard, sharding, dtype policy. Fails fast with a PreflightError
@@ -325,6 +341,7 @@ class Trainer:
         # leaves either the previous or the new checkpoint restorable;
         # only a truly torn state (e.g. pre-staging layouts) falls back to
         # training from scratch rather than dying.
+        resumed_from = None
         if (
             self.resume
             and self.ckpt_dir
@@ -347,8 +364,19 @@ class Trainer:
                 best_val = float(r_meta["best_val"])
             if r_meta.get("scheduler"):
                 scheduler.load_state_dict(r_meta["scheduler"])
+            # Divergence rollback (resilience supervisor): the relaunch
+            # carries MTT_LR_SCALE so the restored run retries the diverged
+            # stretch at a reduced LR instead of replaying the same blow-up.
+            lr_scale = float(os.environ.get("MTT_LR_SCALE", "1") or 1.0)
+            if lr_scale != 1.0:
+                scheduler.lr *= lr_scale
+                self._print(
+                    f"rollback: LR scaled by {lr_scale:g} -> "
+                    f"{scheduler.lr:.3g}"
+                )
+            resumed_from = str(self.ckpt_dir / "last")
             self._print(
-                f"resuming from {self.ckpt_dir / 'last'} at epoch {start_epoch}"
+                f"resuming from {resumed_from} at epoch {start_epoch}"
             )
         # Commit to the mesh BEFORE the first epoch: epoch outputs carry
         # mesh-tagged avals, and untagged first-call inputs would otherwise
@@ -469,6 +497,7 @@ class Trainer:
                 objective=spec.objective,
                 trainer=self.name,
                 seed=self.seed,
+                resumed_from=resumed_from,
                 distributed=distributed_run_context(),
             )
             # Gradient-sync footprint of the flat update path: one collective
@@ -543,6 +572,11 @@ class Trainer:
             row.update(
                 {f"loss/{k}/train": v for k, v in train_metrics.items()}
             )
+            # Fault point (host-side, post-device-sums): a `nan` fault
+            # poisons the readback exactly as a diverged step would, driving
+            # the real halt + supervisor-rollback machinery downstream.
+            if faults.fire("trainer.loss", epoch=row["epoch"]) == "nan":
+                row["loss/total/train"] = float("nan")
             if flight is not None:
                 # Divergence context for crashdumps: the recent loss/lr
                 # history shows WHETHER the run was blowing up when it died.
@@ -587,6 +621,7 @@ class Trainer:
             jax.block_until_ready(params)
 
         for epoch in range(start_epoch, self.max_epochs):
+            faults.fire("trainer.epoch_start", epoch=epoch)
             prof.maybe_start(epoch)
             if flight is not None:
                 # Progress marker for the hang watchdog (host memory only —
@@ -602,6 +637,10 @@ class Trainer:
             params, opt_state, sums = run_epoch(
                 params, opt_state, lr, epoch_rng, epoch
             )
+            # "Mid-epoch" fault point: the epoch's update is dispatched but
+            # nothing about it is checkpointed yet — a kill here loses
+            # exactly this epoch's work (the chaos tests' preemption site).
+            faults.fire("trainer.epoch_dispatched", epoch=epoch)
             total_steps += steps_per_epoch
             # 'lr-Adam' matches the reference's LearningRateMonitor scalar
             # tag (reference: train.py:162-165 names it lr-<optimizer>).
@@ -637,7 +676,16 @@ class Trainer:
                 (epoch + 1) % self.check_val_every_n_epoch == 0
                 and val_prepared
             )
-            if is_val or t_start is None or prof.wants_fence(epoch):
+            # Epoch-granular auto-checkpoint cadence: forces the fenced
+            # path so the divergence check runs BEFORE the save — 'last'
+            # must never hold poisoned params (auto-resume would restart
+            # from them).
+            is_ckpt = bool(
+                self.checkpoint_every_n_epochs
+                and self.ckpt_dir
+                and (epoch + 1) % self.checkpoint_every_n_epochs == 0
+            )
+            if is_val or is_ckpt or t_start is None or prof.wants_fence(epoch):
                 # This readback blocks on the epoch's device sums — the only
                 # fences in the loop, and all at boundaries the trainer
                 # needs anyway (val sync, compile watermark, profile window).
@@ -673,6 +721,14 @@ class Trainer:
                                    val_loss, dm, scheduler, best_val)
                     self._save("last", params, opt_state, spec, epoch,
                                val_loss, dm, scheduler, best_val)
+                elif is_ckpt:
+                    # Non-val cadence save: the loss is confirmed finite by
+                    # the readback above; scheduler/best_val are unchanged
+                    # since the last val epoch, so a resume from here is
+                    # bit-identical to having never stopped.
+                    self._save("last", params, opt_state, spec, epoch,
+                               row.get("loss/total/train", float("inf")),
+                               dm, scheduler, best_val)
                 emit(row)
             else:
                 pending = (row, sums)
@@ -775,6 +831,7 @@ class Trainer:
               scheduler=None, best_val=None):
         if not self.ckpt_dir:
             return
+        t0 = time.perf_counter()
         ckpt_lib.save_checkpoint(
             self.ckpt_dir, tag, params, opt_state, spec,
             meta={
@@ -794,6 +851,17 @@ class Trainer:
                 },
             },
         )
+        if self.telemetry:
+            # Lost-work accounting: `telemetry summarize` measures the gap
+            # between a dead attempt's last activity and its last
+            # checkpoint_saved to report how much training a restart cost.
+            self.telemetry.event(
+                "checkpoint_saved",
+                tag=tag,
+                epoch=epoch,
+                wall_s=time.perf_counter() - t0,
+                path=str(self.ckpt_dir / tag),
+            )
 
     def _print(self, msg: str) -> None:
         if self.enable_progress_bar and jax.process_index() == 0:

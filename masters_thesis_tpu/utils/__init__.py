@@ -1,6 +1,8 @@
 """Shared host-side utilities."""
 
 from masters_thesis_tpu.utils.backend_probe import (
+    BackendHealth,
+    HealthDecision,
     ProbeResult,
     distributed_client_initialized,
     multihost_rank,
@@ -16,6 +18,8 @@ from masters_thesis_tpu.utils.io import (
 )
 
 __all__ = [
+    "BackendHealth",
+    "HealthDecision",
     "ProbeResult",
     "atomic_publish",
     "atomic_write_text",

@@ -22,13 +22,20 @@ Policy knobs:
 
 from __future__ import annotations
 
+import json
+import os
 import subprocess
 import sys
 import time
 from dataclasses import dataclass
+from pathlib import Path
+
+from masters_thesis_tpu.resilience import faults
 
 DEFAULT_TIMEOUT_S = 120.0
 DEFAULT_BACKOFF_S = 15.0
+DEFAULT_BUDGET_S = 600.0
+DEFAULT_CACHE_TTL_S = 900.0
 
 
 @dataclass
@@ -36,6 +43,146 @@ class ProbeResult:
     ok: bool
     attempts: int
     detail: str  # "" when ok; reason + child stderr tail otherwise
+
+
+@dataclass
+class HealthDecision:
+    """Outcome of :meth:`BackendHealth.ensure_responsive`."""
+
+    ok: bool
+    degraded: bool  # not ok: caller should fail over to the CPU mesh
+    attempts: int
+    detail: str
+    known_wedged: bool  # cache said wedged within TTL -> single attempt
+    cached_age_s: float | None
+
+
+def pin_cpu(env: dict) -> dict:
+    """The one CPU-pinning incantation: ``JAX_PLATFORMS`` alone is NOT
+    enough — the relay plugin trigger env must go too or the axon
+    sitecustomize re-selects the TPU plugin regardless (ADVICE r4)."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def pin_cpu_in_process() -> None:
+    """Force THIS process onto the CPU backend, even after ``import jax``.
+
+    JAX captures ``JAX_PLATFORMS`` at import time, so the env var alone is
+    not enough once anything has imported jax (ADVICE r4); the config
+    update is what actually pins the platform pre-init.
+    """
+    pin_cpu(os.environ)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+class BackendHealth:
+    """Shared probe-cache + wedge-detection policy (lifted from bench.py).
+
+    The last probe outcome is persisted (atomic write, short TTL); within
+    the TTL a known-wedged lease gets ONE probe attempt (``budget_s=0``)
+    instead of re-burning the full retry budget re-timing-out against a
+    lease a previous run already found dead (BENCH_r05 lost all 600s that
+    way). Consumers: bench.py (perf evidence capture) and the resilience
+    supervisor (pre-attempt health gate / CPU degradation).
+    """
+
+    def __init__(
+        self,
+        cache_path: Path | str,
+        ttl_s: float = DEFAULT_CACHE_TTL_S,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        budget_s: float = DEFAULT_BUDGET_S,
+        backoff_s: float = DEFAULT_BACKOFF_S,
+    ) -> None:
+        self.cache_path = Path(cache_path)
+        self.ttl_s = ttl_s
+        self.timeout_s = timeout_s
+        self.budget_s = budget_s
+        self.backoff_s = backoff_s
+
+    def read_cache(self) -> dict | None:
+        """Last probe outcome, or None when absent/corrupt/expired."""
+        try:
+            cached = json.loads(self.cache_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        if not isinstance(cached, dict):
+            return None
+        at = cached.get("at")
+        if not isinstance(at, (int, float)) or time.time() - at > self.ttl_s:
+            return None
+        return cached
+
+    def record(self, ok: bool, detail: str = "") -> None:
+        """Best-effort persist: the cache must never cost the run."""
+        try:
+            from masters_thesis_tpu.utils.io import atomic_write_text
+
+            self.cache_path.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_text(
+                self.cache_path,
+                json.dumps(
+                    {"ok": ok, "at": time.time(), "detail": detail[-500:]},
+                    indent=2,
+                ),
+            )
+        except OSError:
+            pass
+
+    def record_wedge(self, detail: str) -> None:
+        """A mid-run watchdog kill established the lease is wedged."""
+        self.record(False, detail)
+
+    def ensure_responsive(
+        self, single_attempt: bool = False, log=None
+    ) -> HealthDecision:
+        """Probe backend init under the cache policy.
+
+        ``single_attempt=True`` forces budget 0 regardless of the cache
+        (the supervisor's policy: IT owns retries, so the probe gets one
+        shot per attempt). Does NOT pin CPU itself — degradation is the
+        caller's decision to apply and record.
+        """
+        log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+        cached = self.read_cache()
+        known_wedged = cached is not None and not cached.get("ok")
+        cached_age_s = (
+            time.time() - cached["at"] if cached is not None else None
+        )
+        if known_wedged:
+            # ONE attempt (budget_s=0 -> no retries), then fail over on
+            # the first timeout instead of re-burning the retry budget.
+            log(
+                "probe cache says lease was wedged "
+                f"{cached_age_s:.0f}s ago; single probe attempt"
+            )
+        budget_s = 0.0 if (known_wedged or single_attempt) else self.budget_s
+        probe = probe_tpu_backend(
+            timeout_s=self.timeout_s,
+            budget_s=budget_s,
+            backoff_s=self.backoff_s,
+        )
+        self.record(probe.ok, probe.detail or "")
+        if not probe.ok:
+            log(
+                f"device probe failed {probe.attempts}x over "
+                f"{budget_s:.0f}s ({probe.detail})"
+            )
+        return HealthDecision(
+            ok=probe.ok,
+            degraded=not probe.ok,
+            attempts=probe.attempts,
+            detail=probe.detail,
+            known_wedged=known_wedged,
+            cached_age_s=cached_age_s,
+        )
 
 
 def distributed_client_initialized() -> bool:
@@ -101,20 +248,27 @@ def probe_tpu_backend(
     while True:
         attempts += 1
         remaining = deadline - time.monotonic()
-        try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=max(10.0, min(timeout_s, remaining))
-                if budget_s else timeout_s,
-                check=True,
-                capture_output=True,
-            )
-            return ProbeResult(True, attempts, "")
-        except subprocess.CalledProcessError as exc:
-            stderr = (exc.stderr or b"").decode(errors="replace")
-            detail = f"init crashed (rc={exc.returncode}): {stderr[-500:]}"
-            break  # deterministic crash: retrying reproduces it
-        except subprocess.TimeoutExpired:
+        # Fault point: a `wedge` fault simulates the subprocess hanging to
+        # its timeout (a wedged lease) without burning the real timeout —
+        # the retry/backoff/budget policy below runs unchanged.
+        timed_out = faults.fire("probe.attempt", n=attempts) == "wedge"
+        if not timed_out:
+            try:
+                subprocess.run(
+                    [sys.executable, "-c", "import jax; jax.devices()"],
+                    timeout=max(10.0, min(timeout_s, remaining))
+                    if budget_s else timeout_s,
+                    check=True,
+                    capture_output=True,
+                )
+                return ProbeResult(True, attempts, "")
+            except subprocess.CalledProcessError as exc:
+                stderr = (exc.stderr or b"").decode(errors="replace")
+                detail = f"init crashed (rc={exc.returncode}): {stderr[-500:]}"
+                break  # deterministic crash: retrying reproduces it
+            except subprocess.TimeoutExpired:
+                timed_out = True
+        if timed_out:
             detail = f"probe timed out after attempt {attempts} (wedged lease)"
             # Per-attempt progress to stderr: an operator tailing the log
             # must be able to tell "probe retrying through a wedge" from

@@ -15,6 +15,7 @@ owned explicitly and shared by every writer.
 
 from __future__ import annotations
 
+import os
 import uuid
 from contextlib import contextmanager
 from pathlib import Path
@@ -22,23 +23,46 @@ from typing import Iterator
 
 
 @contextmanager
-def atomic_publish(path: Path | str) -> Iterator[Path]:
+def atomic_publish(path: Path | str, fsync: bool = False) -> Iterator[Path]:
     """Yield a scratch path; on clean exit, atomically rename onto ``path``.
 
     On exception the scratch file is removed and ``path`` is untouched.
+    With ``fsync=True`` the scratch file's bytes and the directory entry
+    are flushed to stable storage before/after the rename — rename alone
+    is atomic against concurrent readers but not against power loss, and
+    checkpoint manifests must survive both.
     """
     path = Path(path)
     tmp = path.with_name(f"{path.name}.tmp{uuid.uuid4().hex[:12]}")
     try:
         yield tmp
+        if fsync:
+            fsync_path(tmp)
         tmp.replace(path)
+        if fsync:
+            fsync_path(path.parent)
     finally:
         tmp.unlink(missing_ok=True)
 
 
-def atomic_write_text(path: Path | str, text: str) -> None:
-    with atomic_publish(path) as tmp:
+def atomic_write_text(path: Path | str, text: str, fsync: bool = False) -> None:
+    with atomic_publish(path, fsync=fsync) as tmp:
         tmp.write_text(text)
+
+
+def fsync_path(path: Path | str) -> None:
+    """fsync a file or directory, best-effort (not all filesystems allow
+    opening directories, and a failed flush must not fail the publish)."""
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def wait_until(predicate, timeout_s: float, interval_s: float = 0.5) -> bool:

@@ -19,9 +19,12 @@ Engineering constraints this runner absorbs:
   small->large) so a wall-clock cutoff loses the most expensive cells
   last; `--deadline` stops LAUNCHING new cells and caps each cell's
   subprocess timeout.
-- Every cell trains with trainer.resume=true, so re-running this script
-  resumes truncated cells from their last val-epoch checkpoint instead of
-  restarting; completed cells are skipped via the results JSONL.
+- Every cell trains under the resilience supervisor
+  (masters_thesis_tpu.resilience) with trainer.resume=auto: a preempted
+  or crashed attempt is classified and relaunched from its last
+  checkpoint INSIDE the cell's budget, re-running this script resumes
+  truncated cells instead of restarting, and completed cells are skipped
+  via the results JSONL.
 
 Results: one JSON line per finished cell in results/grid_r3.jsonl
 (training wall, best-val, and the ΔL-above-OLS table numbers via
@@ -42,6 +45,13 @@ import subprocess
 import sys
 import time
 from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from masters_thesis_tpu.resilience.supervisor import (  # noqa: E402
+    RunSupervisor,
+    SupervisorConfig,
+)
 
 REPO = Path(__file__).resolve().parent.parent
 RESULTS_DIR = REPO / "results"
@@ -159,53 +169,59 @@ def version_for(loss: str, model: str, trainer: str) -> str:
 
 
 def train_with_retry(
-    cell: str, train_overrides: list[str], budget: float, deadline: float
+    cell: str,
+    train_overrides: list[str],
+    budget: float,
+    deadline: float,
+    ckpt: Path | None = None,
 ) -> tuple[bool, bool]:
-    """Run train.py (with resume) under a wall budget, retrying once after
-    a transient relay failure. Returns ``(completed, truncated)``:
-    completed means train.py exited 0; truncated means the budget or
-    timeout cut training short (the checkpoint, if any, is partial — a
-    re-run with trainer.resume=true continues it)."""
-    t0 = time.time()
-    attempts = 0
-    while True:
-        attempts += 1
-        remaining = budget - (time.time() - t0)
-        if remaining <= 60:
-            log(f"{cell}: cell budget exhausted before attempt {attempts}")
-            return False, True
-        try:
-            train = subprocess.run(
-                [sys.executable, "train.py", *train_overrides,
-                 "trainer.resume=true", "trainer.enable_model_summary=false"],
-                cwd=REPO,
-                timeout=remaining,
-                capture_output=True,
-                text=True,
-            )
-        except subprocess.TimeoutExpired:
-            log(f"{cell}: train hit its cap after {remaining:.0f}s "
-                f"(cell budget {budget:.0f}s); resume will continue it on "
-                "a re-run")
-            return False, True
-        if train.returncode == 0:
-            return True, False
-        # A wedged/crashed relay surfaces as UNAVAILABLE backend errors —
-        # transient, not a property of the cell. Re-probe the TPU and give
-        # the cell ONE more attempt (trainer.resume=true makes the retry
-        # continue from the last val-epoch checkpoint, not restart). The
-        # budget re-check at the top of the loop keeps a long wedge inside
-        # wait_for_tpu from granting an attempt past the deadline. Search
-        # the FULL captured output — progress lines after the backend error
-        # can push the marker out of any fixed-size tail.
-        full = train.stdout + train.stderr
-        transient = "UNAVAILABLE" in full or "Unavailable" in full
-        if transient and attempts == 1 and wait_for_tpu(deadline):
-            log(f"{cell}: transient backend failure; retrying once")
-            continue
-        log(f"{cell}: train FAILED rc={train.returncode}\n"
-            f"{train.stdout[-1500:]}\n{train.stderr[-1500:]}")
-        return False, False
+    """Run train.py (with resume) under the resilience supervisor, within
+    a wall budget. Returns ``(completed, truncated)``: completed means the
+    supervised run reached a ``completed`` verdict; truncated means the
+    budget/timeout cut training short (the checkpoint, if any, is partial
+    — a re-run with trainer.resume=auto continues it).
+
+    The supervisor subsumes this function's old hand-rolled retry: a
+    preempted/killed/UNAVAILABLE attempt is classified transient and
+    relaunched with backoff (resume makes the retry CONTINUE from the last
+    checkpoint, not restart the cell), an instantly-reproduced crash halts
+    with a deterministic verdict instead of burning the cell budget, and a
+    NaN-diverged fit rolls back to the last good checkpoint at a halved
+    LR. Per-attempt stdout/stderr land in <log_dir>/supervisor/."""
+    budget = min(budget, max(60.0, deadline - time.time()))
+    log_dir = ckpt.parent.parent if ckpt is not None else None
+    sup = RunSupervisor(
+        [sys.executable, "train.py", *train_overrides,
+         "trainer.resume=auto", "trainer.enable_model_summary=false"],
+        run_dir=(log_dir / "supervisor") if log_dir else RESULTS_DIR / "supervisor" / cell,
+        cfg=SupervisorConfig(
+            max_retries=2,
+            backoff_s=60.0,
+            backoff_factor=2.0,
+            retry_budget_s=budget,
+            attempt_timeout_s=budget,
+        ),
+        cwd=REPO,
+        watch_dir=(log_dir / "telemetry") if log_dir else None,
+        ckpt_dir=(ckpt.parent if ckpt is not None else None),
+    )
+    result = sup.run()
+    if result.ok:
+        return True, False
+    if result.verdict == "budget_exhausted":
+        log(f"{cell}: cell budget ({budget:.0f}s) cut training short; "
+            "resume will continue it on a re-run")
+        return False, True
+    last = result.attempts[-1] if result.attempts else None
+    reason = last.classification.reason if last else "no attempt launched"
+    err_tail = ""
+    if last is not None:
+        err_file = sup.run_dir / f"attempt_{last.attempt}.err"
+        if err_file.exists():
+            err_tail = err_file.read_text(errors="replace")[-1500:]
+    log(f"{cell}: train FAILED verdict={result.verdict} "
+        f"after {result.n_attempts} attempt(s): {reason}\n{err_tail}")
+    return False, False
 
 
 def ensure_checkpoint(
@@ -244,7 +260,7 @@ def ensure_checkpoint(
     log(f"ensure {cell}: checkpoint missing or unconfirmed; training to "
         "completion (not re-recorded)")
     completed, truncated = train_with_retry(
-        cell, train_overrides, budget, deadline
+        cell, train_overrides, budget, deadline, ckpt=ckpt
     )
     if not completed:
         if truncated and ckpt.exists():
@@ -283,7 +299,7 @@ def run_cell(
     cell_heartbeat(cell, "train", budget_s=round(budget, 1))
     t0 = time.time()
     completed, truncated = train_with_retry(
-        cell, train_overrides, budget, deadline
+        cell, train_overrides, budget, deadline, ckpt=ckpt
     )
     if not completed and not truncated:
         # Hard failure, already logged — attach the fleet verdict the way

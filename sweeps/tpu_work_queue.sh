@@ -57,9 +57,18 @@ echo "== fresh bench capture =="
 beat "bench"
 # --telemetry-dir makes every watchdogged point write its events.jsonl +
 # flight-recorder files under one root, so a failed capture has something
-# for the postmortem below to read.
+# for the postmortem below to read. The resilience supervisor classifies
+# a dead capture (preempted? relay UNAVAILABLE? reproducible crash?) and
+# retries transient failures once; bench handles its own probe-and-pin-CPU
+# degradation in-process, so the supervisor's probe stays off. Supervisor
+# chatter goes to stderr — stdout stays bench's JSON line. The outer
+# timeout is the same last-resort backstop as before.
 BENCH_TEL=results/bench_r4_telemetry
-timeout 2700 python bench.py --telemetry-dir "$BENCH_TEL" \
+timeout 2700 python -m masters_thesis_tpu.resilience run \
+  --run-dir results/bench_r4_supervisor --watch-dir "$BENCH_TEL" \
+  --max-retries 1 --backoff-s 30 --attempt-timeout-s 1800 \
+  --retry-budget-s 2400 \
+  -- python bench.py --telemetry-dir "$BENCH_TEL" \
   > results/bench_r4_tpu.json 2> results/bench_r4_tpu.log
 BENCH_RC=$?
 tail -c 400 results/bench_r4_tpu.json

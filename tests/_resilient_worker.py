@@ -1,0 +1,92 @@
+"""Subprocess trainee for the resilience chaos suite.
+
+Runs a real Trainer.fit on the 8-device virtual CPU mesh (same forced
+platform as conftest.py — set BEFORE jax imports) with epoch-granular
+checkpointing and auto-resume, then dumps the final params to
+``<out>/params.npz``. The kill-resume determinism test launches this twice:
+once uninterrupted (the reference params), once under the RunSupervisor
+with an injected SIGKILL mid-epoch (the supervised attempt chain) — the
+two npz files must be bit-identical.
+
+Usage: python tests/_resilient_worker.py <out_dir> [max_epochs]
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# The package is run from the repo, not installed: python <this file> puts
+# tests/ (not the repo root) on sys.path.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # beat the axon sitecustomize
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    out = Path(sys.argv[1])
+    max_epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    out.mkdir(parents=True, exist_ok=True)
+
+    from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+    from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.telemetry import TelemetryRun
+    from masters_thesis_tpu.train import Trainer
+
+    data_dir = out / "data"
+    if not (data_dir / "stocks.npy").exists():
+        data_dir.mkdir(parents=True, exist_ok=True)
+        r_stocks, r_market, _, _ = SyntheticLogReturns.generate(
+            n_stocks=8, n_samples=4000, seed=1
+        )
+        np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+        np.save(data_dir / "market.npy", np.asarray(r_market))
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24, batch_size=2
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+
+    spec = ModelSpec(
+        objective="mse",
+        hidden_size=8,
+        num_layers=1,
+        dropout=0.0,
+        learning_rate=1e-2,
+    )
+    telemetry = TelemetryRun(out / "telemetry")
+    trainer = Trainer(
+        max_epochs=max_epochs,
+        gradient_clip_val=5.0,
+        # Val every 2 epochs so the NEW cadence path (not the val-epoch
+        # save) is what persists the odd epochs' progress.
+        check_val_every_n_epoch=2,
+        checkpoint_every_n_epochs=1,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+        ckpt_dir=out / "ckpts",
+        resume="auto",
+        telemetry=telemetry,
+    )
+    result = trainer.fit(spec, dm)
+    telemetry.close()
+
+    leaves = jax.tree_util.tree_leaves(jax.device_get(result.params))
+    np.savez(out / "params.npz", **{f"p{i}": a for i, a in enumerate(leaves)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,22 +115,62 @@ def test_ensure_checkpoint_noop_when_confirmed(runner, monkeypatch, tmp_path):
     assert runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
 
 
+def _fake_supervisor(runner, monkeypatch, verdicts, on_launch=None):
+    """Replace the runner's RunSupervisor with a recording fake.
+
+    Cells now train under masters_thesis_tpu.resilience (the supervisor
+    owns retry/rollback; tests/test_resilience.py pins those policies), so
+    these tests fake the supervisor seam rather than subprocess.run.
+    ``verdicts`` is consumed one per launch (last one repeats);
+    ``on_launch(cmd)`` simulates the child's side effects.
+    """
+    from masters_thesis_tpu.resilience.supervisor import (
+        AttemptOutcome,
+        Classification,
+        SupervisorResult,
+    )
+
+    calls = []
+
+    class FakeSupervisor:
+        def __init__(self, cmd, run_dir, cfg=None, **kwargs):
+            calls.append({"cmd": cmd, "cfg": cfg, "kwargs": kwargs})
+            self.run_dir = Path(run_dir)
+
+        def run(self):
+            if on_launch is not None:
+                on_launch(calls[-1]["cmd"])
+            verdict = verdicts[min(len(calls) - 1, len(verdicts) - 1)]
+            kind = "success" if verdict == "completed" else "transient"
+            return SupervisorResult(
+                ok=verdict == "completed",
+                verdict=verdict,
+                attempts=[AttemptOutcome(
+                    attempt=1, rc=0 if verdict == "completed" else 1,
+                    wall_s=0.1,
+                    classification=Classification(kind=kind, reason=verdict),
+                )],
+            )
+
+    monkeypatch.setattr(runner, "RunSupervisor", FakeSupervisor)
+    return calls
+
+
 def test_ensure_checkpoint_retrains_missing(runner, monkeypatch, tmp_path):
     """An environment reset wipes logs/ but not the results JSONL: the
     recorded pretrain cell must be retrained (not skipped) so the warmup
     block can warm-start from it. Completion writes the marker, so a second
     call is a no-op."""
     ckpt = tmp_path / "best"
-    calls = []
 
-    def fake_train(cmd, **kwargs):
-        calls.append(cmd)
+    def publish_ckpt(cmd):
         assert "train.py" in cmd[1]
         ckpt.mkdir()
-        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
 
     monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
-    monkeypatch.setattr(runner.subprocess, "run", fake_train)
+    calls = _fake_supervisor(
+        runner, monkeypatch, ["completed"], on_launch=publish_ckpt
+    )
     assert runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
     assert (tmp_path / "best.ENSURED").exists()
     assert runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
@@ -140,9 +180,7 @@ def test_ensure_checkpoint_retrains_missing(runner, monkeypatch, tmp_path):
 def test_ensure_checkpoint_reports_failure(runner, monkeypatch, tmp_path):
     ckpt = tmp_path / "best"
     monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
-    monkeypatch.setattr(
-        runner.subprocess, "run", _fake_run(returncode=1, stderr="boom")
-    )
+    _fake_supervisor(runner, monkeypatch, ["retries_exhausted"])
     assert not runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
 
 
@@ -154,15 +192,14 @@ def test_ensure_checkpoint_rejects_partial_on_timeout(
     comparison would warm-start from under-trained weights), and a later
     call must resume training rather than fast-path on existence."""
     ckpt = tmp_path / "best"
-    calls = []
 
-    def timeout_train(cmd, **kwargs):
-        calls.append(cmd)
+    def partial_ckpt(cmd):
         ckpt.mkdir(exist_ok=True)  # val-epoch checkpoint landed mid-train
-        raise subprocess.TimeoutExpired(cmd, 1)
 
     monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
-    monkeypatch.setattr(runner.subprocess, "run", timeout_train)
+    calls = _fake_supervisor(
+        runner, monkeypatch, ["budget_exhausted"], on_launch=partial_ckpt
+    )
     assert not runner.ensure_checkpoint("c", [], ckpt, time.time() + 3600)
     assert not (tmp_path / "best.ENSURED").exists()
     # Second call: checkpoint exists but is unconfirmed -> trains again.
@@ -173,24 +210,21 @@ def test_ensure_checkpoint_rejects_partial_on_timeout(
 def test_train_with_retry_retries_transient_backend_failure(
     runner, monkeypatch
 ):
-    attempts = []
-
-    def flaky(cmd, **kwargs):
-        attempts.append(cmd)
-        if len(attempts) == 1:
-            return types.SimpleNamespace(
-                returncode=1, stdout="x" * 5000 + "UNAVAILABLE: relay",
-                stderr="",
-            )
-        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
-
+    """Transient retry now lives in the supervisor: the runner must hand
+    it a config that retries with resume enabled, and map a completed
+    verdict to (completed, not truncated)."""
     monkeypatch.setattr(runner, "wait_for_tpu", lambda deadline: True)
-    monkeypatch.setattr(runner.subprocess, "run", flaky)
+    calls = _fake_supervisor(runner, monkeypatch, ["completed"])
     completed, truncated = runner.train_with_retry(
         "c", [], budget=3600, deadline=time.time() + 3600
     )
     assert completed and not truncated
-    assert len(attempts) == 2
+    assert len(calls) == 1
+    cfg = calls[0]["cfg"]
+    assert cfg.max_retries >= 1
+    assert cfg.retry_budget_s <= 3600
+    assert cfg.attempt_timeout_s <= 3600
+    assert "trainer.resume=auto" in calls[0]["cmd"]
 
 
 def test_ab_sweep_survives_child_timeout(monkeypatch, capsys):
@@ -334,10 +368,7 @@ def test_renderer_midscale_section(monkeypatch, tmp_path, capsys):
 
 
 def test_train_with_retry_truncates_on_timeout(runner, monkeypatch):
-    def timeout_train(cmd, **kwargs):
-        raise subprocess.TimeoutExpired(cmd, 1)
-
-    monkeypatch.setattr(runner.subprocess, "run", timeout_train)
+    _fake_supervisor(runner, monkeypatch, ["budget_exhausted"])
     completed, truncated = runner.train_with_retry(
         "c", [], budget=3600, deadline=time.time() + 3600
     )

@@ -31,6 +31,15 @@ python -m masters_thesis_tpu.telemetry selfcheck || fail=1
 echo "== telemetry postmortem selfcheck =="
 python -m masters_thesis_tpu.telemetry postmortem --selfcheck || fail=1
 
+# 3b. resilience: supervisor end-to-end against jax-free workers
+#     (preempt -> resume, deterministic crash -> halt, NaN -> rollback)
+#     plus the jax-free failure-classification unit.
+echo "== resilience selfcheck =="
+python -m masters_thesis_tpu.resilience selfcheck || fail=1
+echo "== resilience classify (unit) =="
+python -m masters_thesis_tpu.resilience classify --rc -15 \
+    | grep '"kind": "transient"' >/dev/null || fail=1
+
 if [ "${1:-}" = "--fast" ]; then
     exit $fail
 fi

@@ -180,6 +180,9 @@ def run(cfg: Config) -> float:
         preflight=t.get("preflight", False),
         telemetry=telemetry,
         hang_timeout_s=t.get("hang_timeout_s", None),
+        checkpoint_every_n_epochs=cfg.get("resilience", {}).get(
+            "checkpoint_every_n_epochs", None
+        ),
     )
 
     init_state = None
