@@ -59,6 +59,23 @@ RULES: dict[str, tuple[str, str]] = {
         "trace-time audit could not run to completion",
         "infrastructure",
     ),
+    # SV3xx: serve preflight (serve/preflight.py) — same categories, but
+    # the program under audit is the AOT predict executable per bucket.
+    "SV301": (
+        "serve bucket compiled more than once / recompiled after warmup "
+        "(steady-state serving must never trace)",
+        "recompile",
+    ),
+    "SV302": (
+        "implicit host<->device transfer in the serve hot path "
+        "(transfer_guard tripped; request I/O must be explicit device_put/"
+        "device_get only)",
+        "transfer",
+    ),
+    "SV303": (
+        "serve preflight could not run to completion",
+        "infrastructure",
+    ),
 }
 
 _SUPPRESS_RE = re.compile(

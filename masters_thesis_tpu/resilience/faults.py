@@ -70,6 +70,9 @@ POINTS = frozenset(
         "checkpoint.post_publish",  # after publish (kind: corrupt)
         "probe.attempt",  # backend probe attempt (kind: wedge)
         "worker.epoch",  # jax-free selfcheck worker epochs
+        "serve.admit",  # request admission (kind: wedge -> forced shed)
+        "serve.dispatch",  # micro-batch dispatch (wedge -> device error)
+        "serve.pre_swap",  # hot-swap candidate staged (kind: corrupt)
     }
 )
 
@@ -88,10 +91,18 @@ class FaultSpec:
     match: Mapping[str, Any] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
+        # Name the valid values: a typo'd chaos plan should tell the
+        # operator what the harness DOES support, not just what it saw.
         if self.kind not in KINDS:
-            raise ValueError(f"unknown fault kind: {self.kind!r}")
+            raise ValueError(
+                f"unknown fault kind: {self.kind!r} "
+                f"(valid kinds: {', '.join(sorted(KINDS))})"
+            )
         if self.point not in POINTS:
-            raise ValueError(f"unknown fault point: {self.point!r}")
+            raise ValueError(
+                f"unknown fault point: {self.point!r} "
+                f"(valid points: {', '.join(sorted(POINTS))})"
+            )
 
     def matches(self, point: str, attempt: int, ctx: Mapping[str, Any]) -> bool:
         if point != self.point:

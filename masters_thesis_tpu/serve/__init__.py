@@ -1,0 +1,73 @@
+"""Low-latency predict serving with graceful degradation.
+
+The serving stack, bottom to top:
+
+- :mod:`~masters_thesis_tpu.serve.queue` — deadline-aware micro-batching
+  with admission control (jax-free).
+- :mod:`~masters_thesis_tpu.serve.engine` — AOT-compiled predict programs
+  per bucketed batch shape; steady-state serving never traces.
+- :mod:`~masters_thesis_tpu.serve.swap` — canaried checkpoint hot-swap:
+  strict manifest verification, golden-batch canary, atomic swap/rollback.
+- :mod:`~masters_thesis_tpu.serve.server` — the dispatch loop: deadline
+  enforcement (no late answers, ever) and the circuit-breaker CPU
+  degradation policy.
+- :mod:`~masters_thesis_tpu.serve.preflight` — tracelint-style audit of
+  the hot path (SV301–SV303): zero recompiles, no implicit transfers.
+
+Importing this package (and queue/server) stays jax-free so
+``python -m masters_thesis_tpu.serve selfcheck`` runs on machines where
+backend init can hang; the engine/swap/preflight symbols below import
+lazily on first access.
+"""
+
+from masters_thesis_tpu.serve.queue import (
+    MicroBatchQueue,
+    PendingRequest,
+    ServeRequest,
+    ServeResponse,
+    ServiceTimeModel,
+)
+from masters_thesis_tpu.serve.server import InjectedDeviceError, PredictServer
+
+_LAZY = {
+    "PredictEngine": ("masters_thesis_tpu.serve.engine", "PredictEngine"),
+    "BucketOverflowError": (
+        "masters_thesis_tpu.serve.engine", "BucketOverflowError",
+    ),
+    "CheckpointSwapper": ("masters_thesis_tpu.serve.swap", "CheckpointSwapper"),
+    "SwapVerdict": ("masters_thesis_tpu.serve.swap", "SwapVerdict"),
+    "canary_checks": ("masters_thesis_tpu.serve.swap", "canary_checks"),
+    "run_serve_preflight": (
+        "masters_thesis_tpu.serve.preflight", "run_serve_preflight",
+    ),
+    "assert_serve_clean": (
+        "masters_thesis_tpu.serve.preflight", "assert_serve_clean",
+    ),
+    "ServePreflightError": (
+        "masters_thesis_tpu.serve.preflight", "ServePreflightError",
+    ),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)
+
+
+__all__ = [
+    "InjectedDeviceError",
+    "MicroBatchQueue",
+    "PendingRequest",
+    "PredictServer",
+    "ServeRequest",
+    "ServeResponse",
+    "ServiceTimeModel",
+    *sorted(_LAZY),
+]

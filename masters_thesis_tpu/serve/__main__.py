@@ -1,0 +1,271 @@
+"""``python -m masters_thesis_tpu.serve`` — serving gates.
+
+Subcommands:
+
+- ``selfcheck`` — hermetic, JAX-FREE smoke of the request path: the real
+  queue + admission control + dispatch loop + deadline enforcement +
+  canary verdict + breaker/degradation policy, driven with a fake engine.
+  Runs on operator machines where touching the backend can hang on a
+  wedged relay lease (docs/OPERATIONS.md). Exit 1 on any failure; the
+  tools/check.sh serve gate.
+- ``preflight`` — the serve twin of tracelint Pass 2 on a hermetic
+  8-device virtual CPU mesh: every bucket compiles exactly once, zero
+  compile delta in steady state, hot path clean under
+  ``transfer_guard("disallow")`` (rules SV301–SV303). Exit 1 on findings;
+  the other tools/check.sh serve gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+class _FakeEngine:
+    """Backend-free engine stand-in for the selfcheck: obeys the engine
+    protocol (warmup/predict/degrade_to_cpu/window_shape/...) with a
+    configurable service time and failure script."""
+
+    def __init__(self, service_s: float = 0.001, buckets=(1, 2, 4)):
+        import numpy as np
+
+        self._np = np
+        self.service_s = service_s
+        self.buckets = tuple(buckets)
+        self.window_shape = (2, 3, 1)
+        self.max_bucket = self.buckets[-1]
+        self.compile_events = len(self.buckets)
+        self.platform = "fake"
+        self.fail_next = 0  # raise on the next N predict calls
+        self.degraded = False
+
+    def warmup(self) -> float:
+        return self.service_s
+
+    def predict(self, x, params=None):
+        time.sleep(self.service_s)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("scripted device failure")
+        n = x.shape[0]
+        k = self.window_shape[0]
+        return (
+            self._np.zeros((n, k), self._np.float32),
+            self._np.zeros((n, k), self._np.float32),
+        )
+
+    def degrade_to_cpu(self) -> None:
+        self.degraded = True
+        self.fail_next = 0
+
+
+class _StubHealth:
+    """BackendHealth stand-in: a canned single-attempt probe decision."""
+
+    def __init__(self, ok: bool):
+        self._ok = ok
+        self.calls = 0
+
+    def ensure_responsive(self, single_attempt: bool = False, log=None):
+        from masters_thesis_tpu.utils.backend_probe import HealthDecision
+
+        self.calls += 1
+        assert single_attempt, "serve must probe with single_attempt=True"
+        return HealthDecision(
+            ok=self._ok, degraded=not self._ok, attempts=1,
+            detail="" if self._ok else "stubbed wedge",
+            known_wedged=False, cached_age_s=None,
+        )
+
+
+def _selfcheck(args) -> int:
+    import tempfile
+
+    import numpy as np
+
+    from masters_thesis_tpu.resilience import faults
+    from masters_thesis_tpu.serve.queue import (
+        STATUS_OK,
+        STATUS_SHED,
+    )
+    from masters_thesis_tpu.serve.server import PredictServer
+    from masters_thesis_tpu.serve.swap import canary_checks
+    from masters_thesis_tpu.telemetry.run import TelemetryRun
+
+    failures: list[str] = []
+    window = np.zeros((2, 3, 1), np.float32)
+
+    # 1. Happy path: generous deadlines, everything completes before them.
+    engine = _FakeEngine(service_s=0.001)
+    server = PredictServer(engine, max_wait_s=0.002)
+    server.start()
+    pending = [server.submit(window, deadline_s=5.0) for _ in range(10)]
+    results = [p.result(timeout=10.0) for p in pending]
+    server.stop()
+    if not all(r.status == STATUS_OK for r in results):
+        failures.append(
+            "happy path: statuses "
+            f"{sorted({r.status for r in results})} != ['ok']"
+        )
+    if any(r.delivered_ts > p.request.deadline_ts
+           for p, r in zip(pending, results)):
+        failures.append("happy path: a response was delivered past its "
+                        "deadline")
+
+    # 2. Overload: slow engine + tight deadlines -> explicit sheds, zero
+    #    late ok-deliveries, every request resolved.
+    engine = _FakeEngine(service_s=0.02, buckets=(1, 2))
+    server = PredictServer(engine, max_wait_s=0.001)
+    server.start()
+    pending = [server.submit(window, deadline_s=0.05) for _ in range(20)]
+    results = [p.result(timeout=10.0) for p in pending]
+    stats = server.stop()
+    if stats["shed"] + stats["late_converted"] == 0:
+        failures.append(
+            f"overload: nothing was shed or rejected ({stats})"
+        )
+    if stats["late_deliveries"] != 0:
+        failures.append(
+            f"overload: {stats['late_deliveries']} late ok-deliveries"
+        )
+    for p, r in zip(pending, results):
+        if r.status == STATUS_OK and r.delivered_ts > p.request.deadline_ts:
+            failures.append("overload: ok response delivered late")
+            break
+
+    # 3. Forced shed via the serve.admit fault point (the chaos-suite
+    #    mechanism, minus jax).
+    plan = faults.FaultPlan.parse(
+        '{"faults": [{"point": "serve.admit", "kind": "wedge",'
+        ' "attempt": null}]}'
+    )
+    faults.install_plan(plan)
+    try:
+        engine = _FakeEngine()
+        server = PredictServer(engine)
+        server.start()
+        r = server.submit(window, deadline_s=5.0).result(timeout=5.0)
+        server.stop()
+        if r.status != STATUS_SHED or "fault" not in r.detail:
+            failures.append(
+                f"fault shed: got status={r.status!r} detail={r.detail!r}"
+            )
+    finally:
+        faults.clear_plan()
+
+    # 4. Canary verdict math (numpy-only core of the swap gate).
+    ok_pair = (np.zeros((1, 2)), np.zeros((1, 2)))
+    nan_pair = (np.full((1, 2), np.nan), np.zeros((1, 2)))
+    big_pair = (np.full((1, 2), 1e9), np.zeros((1, 2)))
+    if not canary_checks(ok_pair, ok_pair).ok:
+        failures.append("canary: identical outputs rejected")
+    if canary_checks(ok_pair, nan_pair).ok:
+        failures.append("canary: NaN candidate accepted")
+    if canary_checks(ok_pair, big_pair).ok:
+        failures.append("canary: exploded candidate accepted")
+    if canary_checks(ok_pair, (np.ones((1, 2)), np.zeros((1, 2))),
+                     max_drift=0.5).ok:
+        failures.append("canary: drift budget not enforced")
+
+    # 5. Breaker + degradation policy with a stubbed failing probe: the
+    #    scripted failures trip the breaker, ONE probe runs, the engine
+    #    degrades, traffic recovers.
+    with tempfile.TemporaryDirectory() as tmp:
+        tel = TelemetryRun(tmp, run_id="serve-selfcheck")
+        engine = _FakeEngine(service_s=0.001)
+        health = _StubHealth(ok=False)
+        server = PredictServer(
+            engine, telemetry=tel, health=health, breaker_threshold=2,
+            max_wait_s=0.001,
+        )
+        server.start()
+        engine.fail_next = 2
+        # Sequential submit/await: each failure must be its own dispatch,
+        # so exactly two consecutive failures reach the breaker.
+        for _ in range(2):
+            server.submit(window, deadline_s=5.0).result(timeout=10.0)
+        # Wait for the breaker->probe->degrade sequence to land.
+        deadline = time.monotonic() + 5.0
+        while not engine.degraded and time.monotonic() < deadline:
+            time.sleep(0.005)
+        ok_after = server.submit(window, deadline_s=5.0).result(timeout=10.0)
+        stats = server.stop()
+        tel.close()
+        if health.calls != 1:
+            failures.append(f"breaker: {health.calls} probes (wanted 1)")
+        if not engine.degraded or stats["degradations"] != 1:
+            failures.append(f"breaker: no degradation recorded ({stats})")
+        if ok_after.status != STATUS_OK:
+            failures.append(
+                f"breaker: post-degrade request {ok_after.status!r}"
+            )
+
+    if failures:
+        print("serve: selfcheck FAILED: " + "; ".join(failures))
+        return 1
+    print("serve: selfcheck ok")
+    return 0
+
+
+def _force_cpu_mesh(n_devices: int) -> None:
+    """Virtual 8-device CPU mesh regardless of ambient plugins (same
+    incantation as analysis/__main__.py — the audited invariants are
+    properties of the compiled programs, not the backend)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _preflight(args) -> int:
+    _force_cpu_mesh(args.devices)
+    from masters_thesis_tpu.analysis.findings import format_report
+    from masters_thesis_tpu.serve.preflight import run_serve_preflight
+
+    findings = run_serve_preflight(requests=args.requests)
+    print(format_report(findings, as_json=args.json))
+    if not findings and not args.json:
+        print("serve: preflight ok (zero recompiles, transfer-clean)")
+    return 1 if findings else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m masters_thesis_tpu.serve",
+        description="serving-engine gates: jax-free selfcheck + preflight",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_check = sub.add_parser(
+        "selfcheck",
+        help="jax-free smoke of queue/admission/deadline/breaker/canary",
+    )
+    p_check.set_defaults(fn=_selfcheck)
+    p_pre = sub.add_parser(
+        "preflight",
+        help="AOT predict-path audit on a virtual CPU mesh (SV301-SV303)",
+    )
+    p_pre.add_argument(
+        "--devices", type=int, default=8, metavar="N",
+        help="virtual CPU devices for the preflight mesh",
+    )
+    p_pre.add_argument(
+        "--requests", type=int, default=12, metavar="N",
+        help="steady-state requests driven through the hot path",
+    )
+    p_pre.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    p_pre.set_defaults(fn=_preflight)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,271 @@
+"""AOT-compiled predict engine over bucketed batch shapes.
+
+Steady-state serving must NEVER trace (tracing is a multi-second,
+GIL-holding stall — fatal under a latency SLO). So every predict program
+is lowered and compiled ahead-of-time at startup, one per bucketed batch
+size, and requests are padded up to the nearest bucket:
+
+- ``jax.jit(predict).lower(...).compile()`` yields a ``Compiled``
+  executable that can only EXECUTE — a shape it was not built for raises
+  instead of silently retracing, which turns the "no recompiles in
+  serving" policy from a hope into a structural guarantee.
+- The hot path does only EXPLICIT transfers (``jax.device_put`` for the
+  padded request batch, ``jax.device_get`` for the outputs), so it runs
+  clean under ``jax.transfer_guard("disallow")`` — enforced by the serve
+  preflight (serve/preflight.py, rules SV301/SV302).
+- Params live device-resident and replicated; :meth:`set_params` swaps
+  the serving tree atomically under a lock (the hot-swap path,
+  serve/swap.py), and the same compiled executables keep serving — a
+  param swap never recompiles anything.
+
+Degradation: :meth:`degrade_to_cpu` rebuilds the mesh + executables on
+the CPU backend (one compile burst, outside the steady-state guarantee)
+after the server's circuit breaker trips and the single backend probe
+fails — mirroring the supervisor's CPU-failover policy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.parallel import (
+    DATA_AXIS,
+    global_put,
+    make_data_mesh,
+    replicated_sharding,
+)
+from masters_thesis_tpu.train.steps import forward_rows
+
+DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+
+class BucketOverflowError(ValueError):
+    """Request batch larger than the largest compiled bucket."""
+
+
+class PredictEngine:
+    """Bucketed AOT predict programs for one (spec, window-shape) pair.
+
+    ``predict`` maps a host batch ``x (n, K, T, F)`` to per-stock
+    ``(alpha (n, K), beta (n, K))`` numpy arrays, deterministically
+    (dropout off), padding ``n`` up to the nearest compiled bucket.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        params: Any,
+        *,
+        n_stocks: int,
+        lookback: int,
+        n_features: int = 3,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        mesh: Mesh | None = None,
+    ):
+        self.spec = spec
+        self.n_stocks = n_stocks
+        self.lookback = lookback
+        self.n_features = n_features
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"invalid buckets: {buckets!r}")
+        self.mesh = mesh if mesh is not None else make_data_mesh(None)
+        self._module = spec.build_module()
+        #: Monotonic count of XLA compilations this engine performed.
+        #: Steady-state contract: constant after warmup() — the preflight
+        #: asserts the delta is zero over a varied-shape request window.
+        self.compile_events = 0
+        self._compiled: dict[int, tuple[Any, NamedSharding]] = {}
+        self._lock = threading.RLock()
+        self._params = global_put(
+            jax.device_get(params), replicated_sharding(self.mesh)
+        )
+
+    # jit_cache_size()/CompileTracker compatibility: the engine is its own
+    # "jitted callable" for compile accounting purposes.
+    def _cache_size(self) -> int:
+        return self.compile_events
+
+    @property
+    def window_shape(self) -> tuple[int, int, int]:
+        return (self.n_stocks, self.lookback, self.n_features)
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def platform(self) -> str:
+        devs = list(self.mesh.devices.flat)
+        return devs[0].platform if devs else jax.default_backend()
+
+    def _predict_fn(self, params, x):
+        alpha, beta = forward_rows(self._module, params, x)
+        return alpha[..., 0], beta[..., 0]
+
+    def _compile_bucket(self, b: int) -> None:
+        k, t, f = self.window_shape
+        repl = replicated_sharding(self.mesh)
+        # Shard the padded batch over the data axis when it divides evenly;
+        # tiny buckets below the mesh size run replicated (a 1-window
+        # request cannot be split 8 ways).
+        if b % self.mesh.size == 0:
+            x_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        else:
+            x_sh = repl
+        jfn = jax.jit(
+            self._predict_fn,
+            in_shardings=(repl, x_sh),
+            out_shardings=(repl, repl),
+        )
+        x_struct = jax.ShapeDtypeStruct((b, k, t, f), jnp.float32)
+        self._compiled[b] = (
+            jfn.lower(self._params, x_struct).compile(),
+            x_sh,
+        )
+        self.compile_events += 1
+
+    def warmup(self) -> float:
+        """Compile every bucket and return the measured wall seconds of one
+        max-bucket execution (seeds the queue's service-time model)."""
+        for b in self.buckets:
+            if b not in self._compiled:
+                self._compile_bucket(b)
+        k, t, f = self.window_shape
+        x = np.zeros((self.max_bucket, k, t, f), np.float32)
+        self.predict(x)  # execute once so the timing below is steady-state
+        t0 = time.perf_counter()
+        self.predict(x)
+        return time.perf_counter() - t0
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise BucketOverflowError(
+            f"batch of {n} exceeds largest compiled bucket "
+            f"{self.max_bucket} (buckets: {self.buckets})"
+        )
+
+    def put_params(self, host_params: Any) -> Any:
+        """Place a candidate host param tree device-resident with the
+        serving sharding (canary staging; does NOT swap)."""
+        return global_put(host_params, replicated_sharding(self.mesh))
+
+    def set_params(self, device_params: Any) -> Any:
+        """Atomically swap the serving params; returns the old tree (the
+        swapper keeps it for rollback bookkeeping)."""
+        with self._lock:
+            old, self._params = self._params, device_params
+            return old
+
+    def predict(
+        self, x: np.ndarray, params: Any = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Run one padded micro-batch through the bucket's AOT executable.
+
+        ``params`` overrides the serving tree for this call only (the
+        canary path evaluates a candidate without exposing it to traffic).
+        Only explicit transfers: device_put in, device_get out.
+        """
+        x = np.asarray(x, np.float32)
+        if x.ndim != 4 or x.shape[1:] != self.window_shape:
+            raise ValueError(
+                f"request shape {x.shape} != (n, {self.n_stocks}, "
+                f"{self.lookback}, {self.n_features})"
+            )
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if n < b:
+            # Pad by repeating the first window: finite data (padding with
+            # garbage could manufacture inf/nan that trips output checks),
+            # sliced off before returning.
+            pad = np.broadcast_to(x[:1], (b - n,) + x.shape[1:])
+            x = np.concatenate([x, pad], axis=0)
+        compiled, x_sh = self._compiled[b]
+        xd = jax.device_put(np.ascontiguousarray(x), x_sh)
+        with self._lock:
+            p = self._params if params is None else params
+        alpha, beta = compiled(p, xd)
+        return (
+            np.asarray(jax.device_get(alpha))[:n],
+            np.asarray(jax.device_get(beta))[:n],
+        )
+
+    def golden_batch(self, n: int = 1, seed: int = 0) -> np.ndarray:
+        """Deterministic canary input matched to this engine's window shape."""
+        k, t, f = self.window_shape
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal((n, k, t, f)).astype(np.float32)
+
+    def degrade_to_cpu(self) -> None:
+        """Rebuild mesh + executables on the CPU backend (breaker policy).
+
+        One deliberate compile burst — compile_events grows — after which
+        the steady-state no-trace contract holds again on the new mesh.
+        """
+        from masters_thesis_tpu.utils.backend_probe import pin_cpu_in_process
+
+        host_params = jax.device_get(self._params)
+        pin_cpu_in_process()
+        cpu = jax.devices("cpu")
+        with self._lock:
+            self.mesh = Mesh(np.asarray(cpu[:1]), axis_names=(DATA_AXIS,))
+            self._params = global_put(
+                host_params, replicated_sharding(self.mesh)
+            )
+            self._compiled.clear()
+            for b in self.buckets:
+                self._compile_bucket(b)
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        ckpt_dir,
+        tag: str = "best",
+        *,
+        n_stocks: int,
+        n_features: int = 3,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        mesh: Mesh | None = None,
+    ) -> "PredictEngine":
+        """Boot an engine from a published checkpoint, STRICT verification:
+        serving never starts from a tree whose content cannot be proven."""
+        from pathlib import Path
+
+        from masters_thesis_tpu.train.checkpoint import (
+            CorruptCheckpointError,
+            restore_checkpoint,
+            verify_checkpoint,
+        )
+
+        path = Path(ckpt_dir) / tag
+        if not verify_checkpoint(path, require_manifest=True):
+            raise CorruptCheckpointError(
+                f"refusing to serve from {path}: strict manifest "
+                "verification failed (missing or mismatched MANIFEST.json)"
+            )
+        params, _, spec, meta = restore_checkpoint(ckpt_dir, tag)
+        lookback = meta.get("datamodule", {}).get("lookback_window")
+        if lookback is None:
+            raise ValueError(
+                f"checkpoint sidecar for {path} has no "
+                "datamodule.lookback_window; cannot size predict programs"
+            )
+        return cls(
+            spec,
+            params,
+            n_stocks=n_stocks,
+            lookback=int(lookback),
+            n_features=n_features,
+            buckets=buckets,
+            mesh=mesh,
+        )

@@ -1,0 +1,151 @@
+"""Serve preflight: prove the predict hot path cannot trace or transfer.
+
+Tracelint's Pass-2 (analysis/traceaudit.py) audits the TRAINING epoch
+program; this is its serving twin. It builds a hermetic engine on the
+current mesh, warms every bucket, then drives a steady-state request
+window of varied batch sizes and asserts the serving contract:
+
+- **SV301** — compile accounting: warmup compiles exactly one executable
+  per bucket, and the compile-event delta over the steady-state window is
+  ZERO. AOT ``Compiled`` programs cannot retrace by construction; this
+  catches the regression where predict falls back to a plain ``jax.jit``
+  call (or a bucket is compiled lazily on the request path).
+- **SV302** — the whole steady-state window runs under
+  ``jax.transfer_guard("disallow")``: request I/O must be explicit
+  ``device_put``/``device_get`` only; any implicit host touch raises.
+- **SV303** — the preflight itself failed to run (infrastructure — a red
+  check, never a silent green).
+
+Sized to run in seconds on the 8-device virtual CPU mesh; the invariants
+are properties of the compiled programs, not of the backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from masters_thesis_tpu.analysis.findings import Finding
+
+PREFLIGHT_STOCKS = 4
+PREFLIGHT_LOOKBACK = 8
+PREFLIGHT_FEATURES = 3
+PREFLIGHT_BUCKETS = (1, 2, 4, 8)
+PREFLIGHT_REQUESTS = 12
+
+
+class ServePreflightError(RuntimeError):
+    """Raised by :func:`assert_serve_clean` when the preflight finds
+    violations of the serving contract."""
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = findings
+        super().__init__(
+            "serve preflight failed:\n"
+            + "\n".join(f.format() for f in findings)
+        )
+
+
+def run_serve_preflight(
+    spec=None,
+    mesh=None,
+    buckets=PREFLIGHT_BUCKETS,
+    requests: int = PREFLIGHT_REQUESTS,
+) -> list[Finding]:
+    """Build a hermetic engine and audit its hot path; [] when clean."""
+    try:
+        return _run(spec, mesh, buckets, requests)
+    except Exception as exc:  # noqa: BLE001 — SV303 carries the cause
+        return [
+            Finding(
+                rule="SV303",
+                message=f"preflight could not run: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+
+
+def _run(spec, mesh, buckets, requests) -> list[Finding]:
+    import jax
+    import jax.numpy as jnp
+
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.serve.engine import PredictEngine
+
+    findings: list[Finding] = []
+    if spec is None:
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            kernel_impl="xla",
+        )
+    module = spec.build_module()
+    dummy = jnp.zeros((1, PREFLIGHT_LOOKBACK, PREFLIGHT_FEATURES),
+                      jnp.float32)
+    params = module.init(jax.random.key(0), dummy)["params"]
+    engine = PredictEngine(
+        spec, params,
+        n_stocks=PREFLIGHT_STOCKS,
+        lookback=PREFLIGHT_LOOKBACK,
+        n_features=PREFLIGHT_FEATURES,
+        buckets=buckets,
+        mesh=mesh,
+    )
+
+    engine.warmup()
+    if engine.compile_events != len(engine.buckets):
+        findings.append(
+            Finding(
+                rule="SV301",
+                message=f"warmup compiled {engine.compile_events} "
+                f"executables for {len(engine.buckets)} buckets "
+                f"{engine.buckets} (expected exactly one per bucket)",
+            )
+        )
+
+    # Steady-state window: request sizes sweep every bucket boundary
+    # (exact fits and pad-to-bucket), inputs pre-generated on the host.
+    rng = np.random.default_rng(0)
+    sizes = [1 + (i % engine.max_bucket) for i in range(requests)]
+    k, t, f = engine.window_shape
+    inputs = [
+        rng.standard_normal((n, k, t, f)).astype(np.float32) for n in sizes
+    ]
+    baseline = engine.compile_events
+    alpha = beta = np.zeros((1,), np.float32)
+    try:
+        with jax.transfer_guard("disallow"):
+            for x in inputs:
+                alpha, beta = engine.predict(x)
+    except Exception as exc:  # noqa: BLE001 — the guard raises plain errors
+        findings.append(
+            Finding(
+                rule="SV302",
+                message=f"implicit host transfer in the serve hot path: "
+                f"{exc}",
+            )
+        )
+    delta = engine.compile_events - baseline
+    if delta:
+        findings.append(
+            Finding(
+                rule="SV301",
+                message=f"steady-state serving compiled {delta} new "
+                f"executable(s) over {requests} varied-size requests "
+                "(expected 0 — serving must never trace)",
+            )
+        )
+    if not np.isfinite(alpha).all() or not np.isfinite(beta).all():
+        findings.append(
+            Finding(
+                rule="SV303",
+                message="preflight predictions are non-finite on random "
+                "inputs (engine wiring is broken)",
+            )
+        )
+    return findings
+
+
+def assert_serve_clean(**kwargs) -> None:
+    """Gate form: raise :class:`ServePreflightError` on any finding."""
+    findings = run_serve_preflight(**kwargs)
+    if findings:
+        raise ServePreflightError(findings)

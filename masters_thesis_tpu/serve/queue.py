@@ -1,0 +1,255 @@
+"""Deadline-aware micro-batching queue with admission control (jax-free).
+
+The request path's robustness rules live here, deliberately independent of
+any backend:
+
+- every request carries an absolute deadline (monotonic clock);
+- the queue fires a micro-batch when ``max_batch`` requests are waiting or
+  the oldest waiting request has aged ``max_wait_s`` — whichever first;
+- admission control sheds load EARLY: a request whose deadline the current
+  backlog already makes infeasible (estimated via an EWMA of measured
+  batch service time) is rejected at submit time with an explicit ``shed``
+  response instead of being served late — a late answer is worthless to
+  the caller and steals capacity from every request behind it;
+- the server converts any response that would still be delivered past its
+  deadline into an explicit rejection (server.py): the engine never
+  returns a late answer as if it were good.
+
+Fault point ``serve.admit`` (kind ``wedge``) forces a shed at submit time,
+so the chaos suite can drive deterministic overload decisions without
+having to race the real clock.
+
+Jax-free by contract: ``python -m masters_thesis_tpu.serve selfcheck``
+drives this module (and the server loop) with a fake engine on operator
+machines where touching the backend can hang (docs/OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from masters_thesis_tpu.resilience import faults
+
+#: Response statuses. ``shed`` and ``rejected_late`` are both explicit
+#: rejections — the difference is WHEN the server gave up: at admission
+#: (predicted infeasible) vs. after compute (finished past the deadline).
+STATUS_OK = "ok"
+STATUS_SHED = "shed"
+STATUS_REJECTED_LATE = "rejected_late"
+STATUS_ERROR = "error"
+
+
+@dataclass
+class ServeRequest:
+    """One predict request: a single window ``x`` of shape (K, T, F) plus
+    an absolute deadline on the monotonic clock."""
+
+    rid: int
+    x: Any  # np.ndarray (K, T, F); typed Any to keep this module jax/np-light
+    deadline_ts: float
+    submitted_ts: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class ServeResponse:
+    rid: int
+    status: str  # STATUS_* above
+    outputs: tuple | None = None  # (alpha (K,), beta (K,)) when ok
+    detail: str = ""
+    delivered_ts: float = 0.0
+    latency_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+class PendingRequest:
+    """Future for a submitted request; resolved exactly once."""
+
+    def __init__(self, request: ServeRequest):
+        self.request = request
+        self._done = threading.Event()
+        self._response: ServeResponse | None = None
+
+    def resolve(self, response: ServeResponse) -> None:
+        if self._done.is_set():  # first resolution wins (shed vs late race)
+            return
+        self._response = response
+        self._done.set()
+
+    def result(self, timeout: float | None = None) -> ServeResponse:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.rid} unresolved after {timeout}s"
+            )
+        assert self._response is not None
+        return self._response
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class ServiceTimeModel:
+    """EWMA of measured per-batch service seconds.
+
+    Admission control needs a forecast, not an average over history: the
+    EWMA tracks the CURRENT service rate (which shifts when the server
+    degrades to CPU) while smoothing over per-batch jitter. Thread-safe;
+    written by the dispatch thread, read by every submitter.
+    """
+
+    def __init__(self, alpha: float = 0.3, initial_s: float = 0.05):
+        self.alpha = alpha
+        self._batch_s = initial_s
+        self._lock = threading.Lock()
+
+    @property
+    def batch_s(self) -> float:
+        with self._lock:
+            return self._batch_s
+
+    def seed(self, batch_s: float) -> None:
+        """Reset to a measured value (the engine's warmup timing)."""
+        with self._lock:
+            self._batch_s = max(1e-6, batch_s)
+
+    def update(self, batch_s: float) -> None:
+        with self._lock:
+            self._batch_s = (
+                self.alpha * max(1e-6, batch_s)
+                + (1.0 - self.alpha) * self._batch_s
+            )
+
+    def estimate_completion_s(self, queue_depth: int, max_batch: int) -> float:
+        """Seconds until a request admitted NOW would complete: the batches
+        already ahead of it, plus its own batch."""
+        batches_ahead = queue_depth // max(1, max_batch)
+        return (batches_ahead + 1) * self.batch_s
+
+
+class MicroBatchQueue:
+    """Bounded FIFO with deadline admission and max-wait/max-batch firing."""
+
+    def __init__(
+        self,
+        max_batch: int = 8,
+        max_wait_s: float = 0.005,
+        max_depth: int = 256,
+        service_model: ServiceTimeModel | None = None,
+        on_shed: Callable[[ServeRequest, str], None] | None = None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1: {max_batch}")
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.max_depth = max_depth
+        self.service_model = service_model or ServiceTimeModel()
+        self.on_shed = on_shed
+        self._items: list[PendingRequest] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self.submitted = 0
+        self.shed = 0
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def _shed(self, pending: PendingRequest, reason: str) -> PendingRequest:
+        self.shed += 1
+        now = time.monotonic()
+        pending.resolve(
+            ServeResponse(
+                rid=pending.request.rid,
+                status=STATUS_SHED,
+                detail=reason,
+                delivered_ts=now,
+                latency_s=now - pending.request.submitted_ts,
+            )
+        )
+        if self.on_shed is not None:
+            self.on_shed(pending.request, reason)
+        return pending
+
+    def submit(self, request: ServeRequest) -> PendingRequest:
+        """Admit or shed; always returns a PendingRequest (a shed one is
+        already resolved). Never blocks on capacity — backpressure is an
+        explicit rejection, not a stalled caller."""
+        pending = PendingRequest(request)
+        self.submitted += 1
+        with self._cond:
+            depth = len(self._items)
+            closed = self._closed
+        if closed:
+            return self._shed(pending, "server shutting down")
+        if faults.fire("serve.admit", rid=request.rid, depth=depth) == "wedge":
+            return self._shed(pending, "injected admission shed (fault)")
+        if depth >= self.max_depth:
+            return self._shed(pending, f"queue full (depth {depth})")
+        est = self.service_model.estimate_completion_s(depth, self.max_batch)
+        now = time.monotonic()
+        if now + est > request.deadline_ts:
+            budget_ms = (request.deadline_ts - now) * 1e3
+            return self._shed(
+                pending,
+                f"deadline infeasible: est {est * 1e3:.1f}ms > "
+                f"budget {budget_ms:.1f}ms at depth {depth}",
+            )
+        with self._cond:
+            if self._closed:  # re-check under the lock (close() raced us)
+                pass
+            else:
+                self._items.append(pending)
+                self._cond.notify_all()
+                return pending
+        return self._shed(pending, "server shutting down")
+
+    def next_batch(self, timeout_s: float = 0.1) -> list[PendingRequest]:
+        """Block until a micro-batch is ready; [] on timeout or close.
+
+        Fires when ``max_batch`` requests are waiting, or the oldest
+        waiting request has aged ``max_wait_s`` — latency is bounded by
+        max-wait even at low QPS, throughput by max-batch at high QPS.
+        """
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                if self._items:
+                    oldest = self._items[0].request.submitted_ts
+                    fire_at = oldest + self.max_wait_s
+                    if (
+                        len(self._items) >= self.max_batch
+                        or now >= fire_at
+                        or self._closed
+                    ):
+                        batch = self._items[: self.max_batch]
+                        del self._items[: len(batch)]
+                        return batch
+                    wake = min(fire_at, deadline)
+                else:
+                    if self._closed or now >= deadline:
+                        return []
+                    wake = deadline
+                if now >= wake:
+                    # Timed out while a batch is still aging toward its
+                    # max-wait; hand control back so the caller can re-poll
+                    # (and observe a stop request) instead of spinning.
+                    return []
+                self._cond.wait(wake - now)
+
+    def close(self) -> None:
+        """Stop admitting; wake consumers so they can drain the remainder."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed

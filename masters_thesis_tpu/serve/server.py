@@ -1,0 +1,327 @@
+"""The serving loop: queue -> engine dispatch, deadline enforcement,
+circuit-breaker CPU degradation. Jax-free at import — the engine (real or
+fake) is injected, so the selfcheck CLI can drive this exact loop without
+a backend.
+
+Invariants this module owns:
+
+- **No late answers.** A response is delivered as ``ok`` only if it is
+  handed back BEFORE the request's deadline; a batch that finishes late
+  resolves those requests as explicit ``rejected_late`` rejections. The
+  ``late_deliveries`` counter (an ok delivered past its deadline) must
+  therefore stay 0 by construction — bench.py --serve exits nonzero if it
+  ever isn't.
+- **Degrade, don't flail.** Dispatch errors feed a
+  :class:`~masters_thesis_tpu.utils.backend_probe.CircuitBreaker`;
+  ``breaker_threshold`` consecutive failures buy exactly ONE backend
+  probe (``BackendHealth.ensure_responsive(single_attempt=True)``). If
+  the probe fails, the engine rebuilds on the CPU mesh and a
+  ``degradation`` event is recorded — same policy, same event kind, as
+  the training supervisor.
+- **Non-finite outputs never leave.** A batch whose outputs contain
+  NaN/inf resolves as ``error`` — the canary gate (swap.py) keeps bad
+  params out, this is the last-line check for runtime corruption.
+
+Fault points: ``serve.dispatch`` kind ``wedge`` simulates a device error
+at dispatch (feeding the breaker); kind ``nan`` poisons a batch's outputs
+(exercising the finite check). ``serve.admit`` is handled in queue.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.serve.queue import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_REJECTED_LATE,
+    MicroBatchQueue,
+    PendingRequest,
+    ServeRequest,
+    ServeResponse,
+    ServiceTimeModel,
+)
+from masters_thesis_tpu.utils.backend_probe import CircuitBreaker
+
+
+class InjectedDeviceError(RuntimeError):
+    """Stand-in for a device/runtime failure (serve.dispatch wedge)."""
+
+
+class PredictServer:
+    """Owns the queue, the dispatch thread, and the degradation policy."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: int | None = None,
+        max_wait_s: float = 0.005,
+        max_depth: int = 256,
+        telemetry=None,
+        health=None,
+        breaker_threshold: int = 3,
+    ):
+        self.engine = engine
+        self.telemetry = telemetry
+        self.health = health
+        self.breaker = CircuitBreaker(breaker_threshold)
+        self.service_model = ServiceTimeModel()
+        # The queue's micro-batch can never exceed the largest compiled
+        # bucket — a bigger batch would have to trace a new program.
+        cap = engine.max_bucket
+        self.max_batch = min(max_batch, cap) if max_batch else cap
+        self.queue = MicroBatchQueue(
+            max_batch=self.max_batch,
+            max_wait_s=max_wait_s,
+            max_depth=max_depth,
+            service_model=self.service_model,
+            on_shed=self._on_shed,
+        )
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+        self._started_ts: float | None = None
+        self._dispatch_seq = 0
+        self.completed = 0
+        self.errors = 0
+        self.late_converted = 0
+        #: ok responses delivered past their deadline — 0 by construction;
+        #: anything else is a bug and fails the serve bench.
+        self.late_deliveries = 0
+        self.degradations = 0
+
+    # ------------------------------------------------------------ telemetry
+
+    def _event(self, kind: str, **payload) -> None:
+        if self.telemetry is not None:
+            self.telemetry.event(kind, **payload)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.telemetry is not None:
+            self.telemetry.counter(f"serve/{name}").inc(n)
+
+    def _observe_latency(self, latency_s: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.histogram("serve/latency_s").observe(latency_s)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        warm_s = self.engine.warmup()
+        self.service_model.seed(warm_s)
+        self._started_ts = time.monotonic()
+        self._event(
+            "serve_started",
+            platform=self.engine.platform,
+            buckets=list(self.engine.buckets),
+            max_batch=self.max_batch,
+            max_wait_ms=self.queue.max_wait_s * 1e3,
+            warmup_batch_ms=warm_s * 1e3,
+            compile_events=self.engine.compile_events,
+        )
+        self._thread = threading.Thread(
+            target=self._worker, name="serve-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> dict:
+        """Drain, stop the dispatch thread, emit ``serve_finished``;
+        returns the summary stats dict the event carries."""
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        self._stop.set()
+        stats = self.stats()
+        self._event("serve_finished", **stats)
+        return stats
+
+    def stats(self) -> dict:
+        span = (
+            time.monotonic() - self._started_ts
+            if self._started_ts is not None
+            else 0.0
+        )
+        p50 = p99 = None
+        if self.telemetry is not None:
+            hist = self.telemetry.histogram("serve/latency_s")
+            p50, p99 = hist.quantile(0.5), hist.quantile(0.99)
+        return {
+            "requests": self.queue.submitted,
+            "completed": self.completed,
+            "shed": self.queue.shed,
+            "errors": self.errors,
+            "late_converted": self.late_converted,
+            "late_deliveries": self.late_deliveries,
+            "degradations": self.degradations,
+            "p50_ms": None if p50 is None else p50 * 1e3,
+            "p99_ms": None if p99 is None else p99 * 1e3,
+            "qps": self.completed / span if span > 0 else 0.0,
+            "wall_s": span,
+        }
+
+    # -------------------------------------------------------------- request
+
+    def submit(self, x, deadline_s: float) -> PendingRequest:
+        """Admit one window with a relative deadline budget in seconds."""
+        x = np.asarray(x, np.float32)
+        if x.shape != tuple(self.engine.window_shape):
+            raise ValueError(
+                f"request window shape {x.shape} != engine window shape "
+                f"{tuple(self.engine.window_shape)}"
+            )
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        self._count("requests")
+        return self.queue.submit(
+            ServeRequest(
+                rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s
+            )
+        )
+
+    def _on_shed(self, request: ServeRequest, reason: str) -> None:
+        self._count("shed")
+        self._event("request_shed", rid=request.rid, reason=reason)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _worker(self) -> None:
+        while True:
+            batch = self.queue.next_batch(timeout_s=0.05)
+            if not batch:
+                if self.queue.closed and len(self.queue) == 0:
+                    return
+                continue
+            self._dispatch(batch)
+
+    def _resolve(self, pending: PendingRequest, status: str, detail: str = "",
+                 outputs: tuple | None = None) -> None:
+        now = time.monotonic()
+        pending.resolve(
+            ServeResponse(
+                rid=pending.request.rid,
+                status=status,
+                outputs=outputs,
+                detail=detail,
+                delivered_ts=now,
+                latency_s=now - pending.request.submitted_ts,
+            )
+        )
+
+    def _dispatch(self, batch: list[PendingRequest]) -> None:
+        # Pre-dispatch feasibility re-check: queue wait may have eaten a
+        # request's whole budget; spending device time on it would only
+        # produce a late answer — reject now, serve the rest.
+        est = self.service_model.batch_s
+        now = time.monotonic()
+        live: list[PendingRequest] = []
+        for p in batch:
+            if now + est > p.request.deadline_ts:
+                self.late_converted += 1
+                self._count("late_converted")
+                self._resolve(
+                    p, STATUS_REJECTED_LATE,
+                    "deadline infeasible at dispatch (queue wait consumed "
+                    "the budget); rejected rather than served late",
+                )
+            else:
+                live.append(p)
+        if not live:
+            return
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        kind = faults.fire("serve.dispatch", seq=seq, n=len(live))
+        t0 = time.perf_counter()
+        try:
+            if kind == "wedge":
+                raise InjectedDeviceError(
+                    f"injected device error at dispatch seq={seq}"
+                )
+            xs = np.stack([p.request.x for p in live])
+            alpha, beta = self.engine.predict(xs)
+            if kind == "nan":
+                alpha = np.full_like(alpha, np.nan)
+        except Exception as exc:  # noqa: BLE001 — any dispatch failure
+            self.errors += len(live)
+            self._count("errors", len(live))
+            for p in live:
+                self._resolve(
+                    p, STATUS_ERROR, f"{type(exc).__name__}: {exc}"
+                )
+            if self.breaker.record_failure():
+                self._degrade(exc)
+            return
+        self.service_model.update(time.perf_counter() - t0)
+        self.breaker.record_success()
+        finite = bool(
+            np.isfinite(alpha).all() and np.isfinite(beta).all()
+        )
+        now = time.monotonic()
+        for i, p in enumerate(live):
+            if not finite:
+                self.errors += 1
+                self._count("errors")
+                self._resolve(
+                    p, STATUS_ERROR,
+                    "non-finite predictions; response withheld",
+                )
+            elif now > p.request.deadline_ts:
+                self.late_converted += 1
+                self._count("late_converted")
+                self._resolve(
+                    p, STATUS_REJECTED_LATE,
+                    "batch completed past the deadline; rejected rather "
+                    "than delivered late",
+                )
+            else:
+                self.completed += 1
+                self._count("completed")
+                latency = now - p.request.submitted_ts
+                self._observe_latency(latency)
+                self._resolve(
+                    p, STATUS_OK, outputs=(alpha[i], beta[i])
+                )
+                if time.monotonic() > p.request.deadline_ts:
+                    # The delivery itself slid past the deadline — this
+                    # must never happen (the check above runs against the
+                    # same clock); count it so the bench can fail loudly.
+                    self.late_deliveries += 1
+                    self._count("late_deliveries")
+
+    # ----------------------------------------------------------- degrade
+
+    def _degrade(self, cause: Exception) -> None:
+        """Breaker tripped: ONE probe via the shared BackendHealth, then
+        either keep the backend (transient errors) or rebuild on CPU."""
+        attempts = None
+        if self.health is not None:
+            decision = self.health.ensure_responsive(single_attempt=True)
+            attempts = decision.attempts
+            if decision.ok:
+                self._event(
+                    "breaker_probe_ok",
+                    trips=self.breaker.trips,
+                    attempts=attempts,
+                    cause=repr(cause),
+                )
+                return
+        self.degradations += 1
+        self._count("degradations")
+        self.engine.degrade_to_cpu()
+        self.service_model.seed(self.engine.warmup())
+        self._event(
+            "degradation",
+            scope="serve",
+            reason=f"circuit breaker tripped: {cause!r}",
+            probe_attempts=attempts,
+            platform=self.engine.platform,
+        )

@@ -1,0 +1,218 @@
+"""Canaried checkpoint hot-swap: verify, canary, atomically swap or roll back.
+
+A serving replica must pick up newly trained params without a restart —
+but a torn, bit-flipped, or NaN checkpoint must NEVER reach traffic. The
+swap protocol is a one-way gate with three independent checks, each of
+which rejects without touching the serving params:
+
+1. **Strict manifest verification** — ``verify_checkpoint(...,
+   require_manifest=True)``: the candidate tree's content must prove
+   itself against its sha256 manifest; a manifest-LESS tree (pre-manifest
+   save, or a tree staged without checksums) is refused outright, unlike
+   the lenient training-restore path. No ``.prev`` fallback here: swapping
+   in the previous checkpoint would silently serve stale params while
+   reporting success.
+2. **Shape compatibility** — the candidate param tree must match the
+   serving tree leaf-for-leaf; the AOT executables are shape-specialized,
+   so an architecture change requires a restart, not a swap.
+3. **Golden-batch canary** — the candidate runs on a fixed input through
+   the SAME compiled program as live traffic; outputs must be finite,
+   bounded, and (optionally) within a drift budget of the current params'
+   outputs. The verdict math is plain numpy (:func:`canary_checks`) so
+   the jax-free selfcheck can exercise it.
+
+Only after all three pass does :meth:`CheckpointSwapper.try_swap` flip the
+engine's param pointer — one atomic reference swap under the engine lock;
+in-flight batches finish on the old tree, the next batch sees the new one.
+
+Fault point ``serve.pre_swap`` (kind ``corrupt``) corrupts the candidate
+tree before verification — the chaos suite's torn-checkpoint drill.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from masters_thesis_tpu.resilience import faults
+
+#: Default canary bound on |output|: the estimator's alpha/beta are
+#: standardized-return-scale quantities; anything this large is a blown-up
+#: tree even before NaN shows up.
+DEFAULT_MAX_ABS = 1e3
+
+
+@dataclass
+class SwapVerdict:
+    ok: bool
+    reason: str  # "committed" | "verify_failed" | "restore_failed" |
+    #              "shape_mismatch" | "canary_<check>"
+    detail: str = ""
+    checks: dict = field(default_factory=dict)
+
+
+def canary_checks(
+    current: tuple,
+    candidate: tuple,
+    max_abs: float = DEFAULT_MAX_ABS,
+    max_drift: float | None = None,
+) -> SwapVerdict:
+    """Pure-numpy canary verdict over (alpha, beta) output pairs.
+
+    ``max_drift`` bounds the max elementwise |candidate - current|; None
+    disables the drift check (first deploy after an intentional retrain
+    can move outputs arbitrarily — the operator opts in per rollout).
+    """
+    # Host-side verdict math in f64 on purpose: the drift/abs thresholds
+    # must not be blurred by the comparison's own f32 rounding. Never
+    # traced — these arrays exist only on the host.
+    cur = [np.asarray(a, np.float64) for a in current]  # tracelint: disable=TL104
+    cand = [np.asarray(a, np.float64) for a in candidate]  # tracelint: disable=TL104
+    checks: dict[str, float | bool] = {}
+    finite = all(bool(np.isfinite(a).all()) for a in cand)
+    checks["finite"] = finite
+    if not finite:
+        return SwapVerdict(
+            False, "canary_nonfinite",
+            "candidate produced NaN/inf on the golden batch", checks,
+        )
+    peak = max(float(np.abs(a).max()) for a in cand)
+    checks["max_abs"] = peak
+    if peak > max_abs:
+        return SwapVerdict(
+            False, "canary_abs",
+            f"candidate |output| {peak:.3g} exceeds bound {max_abs:.3g}",
+            checks,
+        )
+    drift = max(
+        float(np.abs(a - b).max()) for a, b in zip(cand, cur)
+    )
+    checks["drift"] = drift
+    if max_drift is not None and drift > max_drift:
+        return SwapVerdict(
+            False, "canary_drift",
+            f"candidate drifted {drift:.3g} from serving outputs "
+            f"(budget {max_drift:.3g})",
+            checks,
+        )
+    return SwapVerdict(True, "committed", checks=checks)
+
+
+def _tree_signature(tree: Any) -> tuple[str, list]:
+    """(treedef repr, per-leaf (shape, dtype)) — host trees only."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return str(treedef), [
+        (tuple(np.shape(leaf)), str(np.asarray(leaf).dtype))
+        for leaf in leaves
+    ]
+
+
+class CheckpointSwapper:
+    """Drives the swap protocol against one engine + checkpoint directory."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        golden_x: np.ndarray | None = None,
+        telemetry=None,
+        max_abs: float = DEFAULT_MAX_ABS,
+        max_drift: float | None = None,
+    ):
+        self.engine = engine
+        self.golden_x = (
+            golden_x if golden_x is not None else engine.golden_batch()
+        )
+        self.telemetry = telemetry
+        self.max_abs = max_abs
+        self.max_drift = max_drift
+        self.committed = 0
+        self.rejected = 0
+
+    def _event(self, kind: str, **payload) -> None:
+        if self.telemetry is not None:
+            self.telemetry.event(kind, **payload)
+
+    def _reject(self, tag: str, verdict: SwapVerdict) -> SwapVerdict:
+        self.rejected += 1
+        self._event(
+            "swap_rejected",
+            tag=tag,
+            reason=verdict.reason,
+            detail=verdict.detail,
+            checks=verdict.checks,
+        )
+        return verdict
+
+    def try_swap(self, ckpt_dir, tag: str = "best") -> SwapVerdict:
+        """Verify + canary ``<ckpt_dir>/<tag>``; swap on pass, keep the
+        current params (and say why) on any failure. Never raises for a
+        bad candidate — a broken checkpoint must not take the replica
+        down, only be refused."""
+        # train.checkpoint imports jax/orbax at module scope; keep serve's
+        # jax-free import surface intact by deferring.
+        from masters_thesis_tpu.train import checkpoint as ckpt
+
+        ckpt_dir = Path(ckpt_dir)
+        path = ckpt_dir / tag
+        if faults.fire("serve.pre_swap", tag=tag) == "corrupt":
+            ckpt._corrupt_tree(path, seed=faults.corruption_seed())
+        if not ckpt.verify_checkpoint(path, require_manifest=True):
+            return self._reject(
+                tag,
+                SwapVerdict(
+                    False, "verify_failed",
+                    f"strict manifest verification failed for {path} "
+                    "(torn/corrupt tree, or no MANIFEST.json)",
+                ),
+            )
+        try:
+            params, _, spec, meta = ckpt.restore_checkpoint(ckpt_dir, tag)
+        except Exception as exc:  # noqa: BLE001 — any restore failure rejects
+            return self._reject(
+                tag,
+                SwapVerdict(
+                    False, "restore_failed",
+                    f"{type(exc).__name__}: {exc}",
+                ),
+            )
+        if _tree_signature(params) != _tree_signature(
+            self._host_serving_params()
+        ):
+            return self._reject(
+                tag,
+                SwapVerdict(
+                    False, "shape_mismatch",
+                    "candidate param tree does not match the serving tree "
+                    "(architecture change requires a replica restart — the "
+                    "AOT predict programs are shape-specialized)",
+                ),
+            )
+        candidate = self.engine.put_params(params)
+        current_out = self.engine.predict(self.golden_x)
+        candidate_out = self.engine.predict(self.golden_x, params=candidate)
+        verdict = canary_checks(
+            current_out, candidate_out,
+            max_abs=self.max_abs, max_drift=self.max_drift,
+        )
+        if not verdict.ok:
+            return self._reject(tag, verdict)
+        self.engine.set_params(candidate)
+        self.committed += 1
+        self._event(
+            "swap_committed",
+            tag=tag,
+            epoch=meta.get("epoch"),
+            checks=verdict.checks,
+        )
+        return verdict
+
+    def _host_serving_params(self) -> Any:
+        import jax
+
+        return jax.device_get(self.engine._params)

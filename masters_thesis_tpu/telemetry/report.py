@@ -121,6 +121,7 @@ def summarize_events(events: list[dict]) -> dict:
     )
 
     restarts = _restart_stats(events, by_kind)
+    serve = _serve_stats(by_kind)
 
     preflight = (by_kind.get("preflight") or [{}])[-1]
     # Gradient-sync footprint (flat update path, train/flatparams.py): the
@@ -178,6 +179,7 @@ def summarize_events(events: list[dict]) -> dict:
             "flat_buffers": grad_sync.get("flat_buffers"),
         },
         "restarts": restarts,
+        "serve": serve,
         "preflight": preflight.get("status"),
         "diverged": finished.get("diverged"),
         "profile_windows": profile_windows,
@@ -242,6 +244,43 @@ def _restart_stats(events: list[dict], by_kind: dict) -> dict:
     }
 
 
+def _serve_stats(by_kind: dict) -> dict | None:
+    """Serving-path accounting; None for runs that never served.
+
+    ``serve_finished`` (server.py stop()) is authoritative for the
+    totals; the raw shed / swap / degradation events keep the section
+    usable for a replica that died before a clean stop.
+    """
+    finished = by_kind.get("serve_finished", [])
+    raw_sheds = len(by_kind.get("request_shed", []))
+    swaps_committed = len(by_kind.get("swap_committed", []))
+    swaps_rejected = len(by_kind.get("swap_rejected", []))
+    if not (
+        finished
+        or by_kind.get("serve_started")
+        or raw_sheds
+        or swaps_committed
+        or swaps_rejected
+    ):
+        return None
+    last = finished[-1] if finished else {}
+    return {
+        "requests": last.get("requests"),
+        "completed": last.get("completed"),
+        "shed": last.get("shed", raw_sheds),
+        "errors": last.get("errors"),
+        "late_converted": last.get("late_converted"),
+        "late_deliveries": last.get("late_deliveries"),
+        "p50_ms": last.get("p50_ms"),
+        "p99_ms": last.get("p99_ms"),
+        "qps": last.get("qps"),
+        "swaps_committed": swaps_committed,
+        "swaps_rejected": swaps_rejected,
+        "degradations": len(by_kind.get("degradation", [])),
+        "clean_stop": bool(finished),
+    }
+
+
 def contract_violations(report: dict) -> list[str]:
     """The runtime contracts a run report is gated on (CLI exits 2)."""
     violations = []
@@ -255,6 +294,13 @@ def contract_violations(report: dict) -> list[str]:
         violations.append("preflight: the tracelint trace audit failed")
     if report.get("diverged"):
         violations.append("divergence: the run halted on a non-finite loss")
+    serve = report.get("serve")
+    if serve and (serve.get("late_deliveries") or 0) > 0:
+        violations.append(
+            f"serve: {serve['late_deliveries']} response(s) delivered past "
+            "their deadline (contract: late answers are rejected, never "
+            "delivered)"
+        )
     return violations
 
 
@@ -314,6 +360,20 @@ def render_text(report: dict) -> str:
         _render_restarts(report.get("restarts") or {}),
         f"preflight      : {report.get('preflight') or 'not recorded'}",
     ]
+    sv = report.get("serve")
+    if sv:
+        lines.insert(
+            len(lines) - 1,
+            f"serve          : {sv.get('completed') or 0}/"
+            f"{sv.get('requests') or 0} ok, shed {sv.get('shed') or 0}, "
+            f"late-rejected {sv.get('late_converted') or 0}, "
+            f"p50 {_fmt(sv.get('p50_ms'), '.2f')}ms / "
+            f"p99 {_fmt(sv.get('p99_ms'), '.2f')}ms, "
+            f"qps {_fmt(sv.get('qps'), '.1f')}, "
+            f"swaps {sv.get('swaps_committed', 0)}+/"
+            f"{sv.get('swaps_rejected', 0)}-, "
+            f"{sv.get('degradations', 0)} degradation(s)",
+        )
     gs = report.get("grad_sync") or {}
     if gs.get("collectives_per_step") is not None:
         lines.insert(

@@ -138,17 +138,22 @@ def _write_manifest(tree: Path) -> None:
     )
 
 
-def verify_checkpoint(path: Path) -> bool:
+def verify_checkpoint(path: Path, require_manifest: bool = False) -> bool:
     """Check a checkpoint tree against its content manifest.
 
-    Trees without a manifest (pre-manifest checkpoints) verify True —
-    backward compatible, no protection. A manifest whose files are
-    missing, truncated, or checksum-mismatched fails.
+    By default, trees without a manifest (pre-manifest checkpoints)
+    verify True — backward compatible, no protection; the training
+    restore path keeps this lenient grandfathering. With
+    ``require_manifest=True`` a manifest-less tree FAILS: the serve
+    hot-swap path uses strict mode so an unverifiable tree (torn write,
+    pre-manifest save, or anything an attacker could stage without
+    checksums) can never be swapped into traffic. A manifest whose files
+    are missing, truncated, or checksum-mismatched fails either way.
     """
     path = Path(path)
     manifest_path = path / MANIFEST_NAME
     if not manifest_path.exists():
-        return path.exists()
+        return path.exists() and not require_manifest
     try:
         manifest = json.loads(manifest_path.read_text())
         for rel, want in manifest["files"].items():

@@ -2,6 +2,7 @@
 
 from masters_thesis_tpu.utils.backend_probe import (
     BackendHealth,
+    CircuitBreaker,
     HealthDecision,
     ProbeResult,
     distributed_client_initialized,
@@ -19,6 +20,7 @@ from masters_thesis_tpu.utils.io import (
 
 __all__ = [
     "BackendHealth",
+    "CircuitBreaker",
     "HealthDecision",
     "ProbeResult",
     "atomic_publish",

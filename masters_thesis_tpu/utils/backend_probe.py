@@ -185,6 +185,40 @@ class BackendHealth:
         )
 
 
+class CircuitBreaker:
+    """Consecutive-failure breaker in front of :class:`BackendHealth`.
+
+    The serving engine (and any future device-touching loop) feeds it one
+    ``record_failure``/``record_success`` per dispatch. ``threshold``
+    consecutive failures TRIP the breaker — the caller then runs exactly
+    one backend probe (``ensure_responsive(single_attempt=True)``) and
+    decides degradation, mirroring the supervisor's policy: isolated
+    errors are absorbed, repeated ones cost one probe, never a retry
+    storm against a wedged lease.
+    """
+
+    def __init__(self, threshold: int = 3) -> None:
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1: {threshold}")
+        self.threshold = threshold
+        self.consecutive = 0
+        self.trips = 0
+
+    def record_success(self) -> None:
+        self.consecutive = 0
+
+    def record_failure(self) -> bool:
+        """Count a failure; True when this one trips the breaker (the
+        consecutive count resets so the caller probes once per trip, not
+        once per failure past the threshold)."""
+        self.consecutive += 1
+        if self.consecutive >= self.threshold:
+            self.consecutive = 0
+            self.trips += 1
+            return True
+        return False
+
+
 def distributed_client_initialized() -> bool:
     """Whether ``jax.distributed.initialize`` has run, across JAX versions.
 

@@ -293,6 +293,65 @@ class TestCheckpointManifest:
         _, _, _, meta = restore_checkpoint(tmp_path, "last")
         assert meta["epoch"] == 0
 
+    def _corrupt_primary(self, tmp_path):
+        from masters_thesis_tpu.train.checkpoint import MANIFEST_NAME
+
+        victim = max(
+            (
+                p
+                for p in (tmp_path / "last").rglob("*")
+                if p.is_file() and p.name != MANIFEST_NAME
+            ),
+            key=lambda p: p.stat().st_size,
+        )
+        blob = bytearray(victim.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        victim.write_bytes(bytes(blob))
+
+    def test_interrupted_rotation_missing_prev_sidecar(self, tmp_path):
+        """A ``.prev`` tree whose sidecar rename was lost mid-rotation is
+        SKIPPED as a fallback: healthy primary restores cleanly; corrupt
+        primary raises deterministically — never a crash, never a
+        half-paired restore."""
+        from masters_thesis_tpu.train.checkpoint import (
+            CorruptCheckpointError,
+            checkpoint_restorable,
+            restore_checkpoint,
+        )
+
+        self._save(tmp_path, 0)
+        self._save(tmp_path, 1)
+        (tmp_path / "last.prev.json").unlink()
+        assert checkpoint_restorable(tmp_path, "last")
+        _, _, _, meta = restore_checkpoint(tmp_path, "last")
+        assert meta["epoch"] == 1  # torn pair ignored, primary served
+        self._corrupt_primary(tmp_path)
+        assert not checkpoint_restorable(tmp_path, "last")
+        with pytest.raises(CorruptCheckpointError):
+            restore_checkpoint(tmp_path, "last")
+
+    def test_interrupted_rotation_missing_prev_tree(self, tmp_path):
+        """The mirror tear: an orphan ``.prev.json`` sidecar without its
+        tree must not be restored from (or crash the candidate scan)."""
+        import shutil
+
+        from masters_thesis_tpu.train.checkpoint import (
+            CorruptCheckpointError,
+            checkpoint_restorable,
+            restore_checkpoint,
+        )
+
+        self._save(tmp_path, 0)
+        self._save(tmp_path, 1)
+        shutil.rmtree(tmp_path / "last.prev")
+        assert (tmp_path / "last.prev.json").exists()
+        _, _, _, meta = restore_checkpoint(tmp_path, "last")
+        assert meta["epoch"] == 1
+        self._corrupt_primary(tmp_path)
+        assert not checkpoint_restorable(tmp_path, "last")
+        with pytest.raises(CorruptCheckpointError):
+            restore_checkpoint(tmp_path, "last")
+
     def test_injected_post_publish_corruption_detected(self, tmp_path):
         """The corrupted-checkpoint fault (flip a byte AFTER publish) is
         exactly what verification must catch."""

@@ -1,0 +1,583 @@
+"""Hardened serving engine (ISSUE 9): AOT predict path, admission
+control, deadline enforcement, canaried hot-swap, breaker degradation.
+
+The chaos suite at the bottom drives the REAL server loop on the 8-device
+virtual CPU mesh (conftest.py) through the three drills the issue names —
+overload, corrupt-swap, wedge — and checks each leaves a distinct
+signature in ``telemetry summarize``.
+"""
+
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.faults import FaultPlan, FaultSpec
+from masters_thesis_tpu.serve.queue import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_SHED,
+    MicroBatchQueue,
+    PendingRequest,
+    ServeRequest,
+    ServeResponse,
+    ServiceTimeModel,
+)
+
+# Tiny window shape shared by every engine in this file.
+K, T, F = 4, 8, 3
+BUCKETS = (1, 2, 4)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults(monkeypatch):
+    """Every test starts and ends with injection off, whatever it does."""
+    monkeypatch.delenv(faults.FAULT_PLAN_ENV, raising=False)
+    monkeypatch.delenv(faults.ATTEMPT_ENV, raising=False)
+    yield
+    faults.clear_plan()
+
+
+def _tiny_spec():
+    from masters_thesis_tpu.models.objectives import ModelSpec
+
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        kernel_impl="xla",
+    )
+
+
+def _init_params(spec, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    return module.init(
+        jax.random.key(seed), jnp.zeros((1, T, F), jnp.float32)
+    )["params"]
+
+
+def _make_engine(buckets=BUCKETS, seed=0):
+    from masters_thesis_tpu.serve.engine import PredictEngine
+
+    spec = _tiny_spec()
+    return PredictEngine(
+        spec, _init_params(spec, seed),
+        n_stocks=K, lookback=T, n_features=F, buckets=buckets,
+    )
+
+
+@pytest.fixture(scope="module")
+def shared_engine():
+    """One warmed engine for the read-only predict tests (swap/degrade
+    tests build their own — they mutate params or the mesh)."""
+    eng = _make_engine()
+    eng.warmup()
+    return eng
+
+
+def _events(tel):
+    from masters_thesis_tpu.telemetry.events import read_events
+
+    return read_events(tel.run_dir / "events.jsonl")
+
+
+# ------------------------------------------------------- queue + admission
+
+
+class TestQueueAdmission:
+    def _req(self, rid=1, deadline_s=10.0):
+        return ServeRequest(
+            rid=rid, x=None, deadline_ts=time.monotonic() + deadline_s
+        )
+
+    def test_submit_admits_within_capacity(self):
+        q = MicroBatchQueue(max_batch=4)
+        p = q.submit(self._req(1))
+        assert not p.done
+        assert len(q) == 1 and q.submitted == 1 and q.shed == 0
+
+    def test_queue_full_sheds_explicitly(self):
+        sheds = []
+        q = MicroBatchQueue(
+            max_batch=4, max_depth=2,
+            on_shed=lambda r, reason: sheds.append((r.rid, reason)),
+        )
+        q.submit(self._req(1))
+        q.submit(self._req(2))
+        p = q.submit(self._req(3))
+        r = p.result(timeout=0)
+        assert r.status == STATUS_SHED and "queue full" in r.detail
+        assert sheds == [(3, r.detail)]
+
+    def test_infeasible_deadline_shed_at_admission(self):
+        q = MicroBatchQueue(max_batch=2)
+        q.service_model.seed(1.0)  # 1s per batch, deterministic forecast
+        r = q.submit(self._req(1, deadline_s=0.1)).result(timeout=0)
+        assert r.status == STATUS_SHED
+        assert "deadline infeasible" in r.detail
+
+    def test_closed_queue_sheds(self):
+        q = MicroBatchQueue()
+        q.close()
+        r = q.submit(self._req(1)).result(timeout=0)
+        assert r.status == STATUS_SHED and "shutting down" in r.detail
+
+    def test_batch_fires_on_max_batch(self):
+        q = MicroBatchQueue(max_batch=2, max_wait_s=60.0)
+        q.service_model.seed(1e-6)
+        for rid in (1, 2, 3):
+            q.submit(self._req(rid))
+        batch = q.next_batch(timeout_s=1.0)
+        assert [p.request.rid for p in batch] == [1, 2]
+        assert len(q) == 1
+
+    def test_batch_fires_on_max_wait(self):
+        q = MicroBatchQueue(max_batch=8, max_wait_s=0.01)
+        q.service_model.seed(1e-6)
+        q.submit(self._req(1))
+        t0 = time.monotonic()
+        batch = q.next_batch(timeout_s=5.0)
+        assert [p.request.rid for p in batch] == [1]
+        assert time.monotonic() - t0 < 4.0  # max-wait fired, not timeout
+
+    def test_next_batch_times_out_empty(self):
+        q = MicroBatchQueue()
+        assert q.next_batch(timeout_s=0.01) == []
+
+    def test_admit_fault_forces_shed(self):
+        faults.install_plan(
+            FaultPlan(
+                faults=(
+                    FaultSpec(
+                        point="serve.admit", kind="wedge", attempt=None
+                    ),
+                )
+            )
+        )
+        q = MicroBatchQueue()
+        r = q.submit(self._req(1)).result(timeout=0)
+        assert r.status == STATUS_SHED and "fault" in r.detail
+
+    def test_first_resolution_wins(self):
+        p = PendingRequest(self._req(1))
+        p.resolve(ServeResponse(rid=1, status=STATUS_SHED))
+        p.resolve(ServeResponse(rid=1, status=STATUS_OK))
+        assert p.result(timeout=0).status == STATUS_SHED
+
+    def test_service_model_estimate_and_ewma(self):
+        m = ServiceTimeModel(alpha=0.5)
+        m.seed(0.1)
+        # depth 0 -> one batch; depth 2*max_batch -> three batches.
+        assert m.estimate_completion_s(0, 4) == pytest.approx(0.1)
+        assert m.estimate_completion_s(8, 4) == pytest.approx(0.3)
+        m.update(0.2)
+        assert m.batch_s == pytest.approx(0.15)
+
+
+# ----------------------------------------------------- fault-point surface
+
+
+class TestServeFaultPoints:
+    def test_serve_points_registered(self):
+        for point in ("serve.admit", "serve.dispatch", "serve.pre_swap"):
+            assert point in faults.POINTS
+
+    def test_unknown_point_error_lists_valid_points(self):
+        with pytest.raises(ValueError) as ei:
+            FaultSpec(point="serve.bogus", kind="wedge")
+        msg = str(ei.value)
+        assert "valid points" in msg and "serve.admit" in msg
+
+    def test_unknown_kind_error_lists_valid_kinds(self):
+        with pytest.raises(ValueError) as ei:
+            FaultSpec(point="serve.admit", kind="bogus")
+        msg = str(ei.value)
+        assert "valid kinds" in msg and "wedge" in msg
+
+
+# ------------------------------------------------- strict checkpoint verify
+
+
+class TestStrictVerify:
+    def _save(self, d):
+        from masters_thesis_tpu.models.objectives import ModelSpec
+        from masters_thesis_tpu.train.checkpoint import save_checkpoint
+
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            learning_rate=1e-2,
+        )
+        save_checkpoint(
+            d, "last", {"w": np.zeros((8,))}, {}, spec, meta={"epoch": 0}
+        )
+
+    def test_manifestless_tree_lenient_vs_strict(self, tmp_path):
+        from masters_thesis_tpu.train.checkpoint import (
+            MANIFEST_NAME,
+            verify_checkpoint,
+        )
+
+        self._save(tmp_path)
+        tree = tmp_path / "last"
+        assert verify_checkpoint(tree, require_manifest=True)
+        (tree / MANIFEST_NAME).unlink()
+        # Training restore stays lenient (pre-manifest saves are trusted);
+        # the serve swap path refuses anything it cannot prove.
+        assert verify_checkpoint(tree)
+        assert not verify_checkpoint(tree, require_manifest=True)
+
+    def test_missing_tree_fails_both_modes(self, tmp_path):
+        from masters_thesis_tpu.train.checkpoint import verify_checkpoint
+
+        assert not verify_checkpoint(tmp_path / "nope")
+        assert not verify_checkpoint(
+            tmp_path / "nope", require_manifest=True
+        )
+
+
+# ------------------------------------------------------------- AOT engine
+
+
+class TestPredictEngine:
+    def test_warmup_compiles_exactly_once_per_bucket(self, shared_engine):
+        assert shared_engine.compile_events == len(shared_engine.buckets)
+
+    def test_steady_state_never_traces(self, shared_engine, rng):
+        before = shared_engine.compile_events
+        for n in (1, 2, 3, 4, 1, 3, 4, 2):
+            x = rng.standard_normal((n, K, T, F)).astype(np.float32)
+            alpha, beta = shared_engine.predict(x)
+            assert alpha.shape == (n, K) and beta.shape == (n, K)
+            assert np.isfinite(alpha).all() and np.isfinite(beta).all()
+        assert shared_engine.compile_events == before
+
+    def test_pad_to_bucket_parity(self, shared_engine):
+        x = shared_engine.golden_batch(4, seed=3)
+        a4, b4 = shared_engine.predict(x)
+        a3, b3 = shared_engine.predict(x[:3])  # pads 3 -> bucket 4
+        np.testing.assert_allclose(a3, a4[:3], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(b3, b4[:3], rtol=1e-5, atol=1e-6)
+
+    def test_bucket_overflow_raises(self, shared_engine):
+        from masters_thesis_tpu.serve.engine import BucketOverflowError
+
+        assert shared_engine.bucket_for(3) == 4
+        with pytest.raises(BucketOverflowError):
+            shared_engine.predict(shared_engine.golden_batch(5))
+
+    def test_bad_window_shape_raises(self, shared_engine):
+        with pytest.raises(ValueError):
+            shared_engine.predict(np.zeros((2, K + 1, T, F), np.float32))
+
+    def test_golden_batch_deterministic(self, shared_engine):
+        a = shared_engine.golden_batch(2, seed=7)
+        b = shared_engine.golden_batch(2, seed=7)
+        assert np.array_equal(a, b)
+
+    def test_preflight_clean_on_test_mesh(self):
+        from masters_thesis_tpu.serve.preflight import run_serve_preflight
+
+        assert run_serve_preflight(buckets=(1, 2), requests=4) == []
+
+
+# ------------------------------------------------------- canaried hot-swap
+
+
+class TestCanaryChecks:
+    def test_verdict_ordering(self):
+        from masters_thesis_tpu.serve.swap import canary_checks
+
+        z = (np.zeros((1, 2)), np.zeros((1, 2)))
+        good = canary_checks(z, z)
+        assert good.ok and good.reason == "committed"
+        assert good.checks["finite"] and good.checks["drift"] == 0.0
+        nan = canary_checks(z, (np.full((1, 2), np.nan), np.zeros((1, 2))))
+        assert not nan.ok and nan.reason == "canary_nonfinite"
+        big = canary_checks(z, (np.full((1, 2), 1e9), np.zeros((1, 2))))
+        assert not big.ok and big.reason == "canary_abs"
+        drift = canary_checks(
+            z, (np.ones((1, 2)), np.zeros((1, 2))), max_drift=0.5
+        )
+        assert not drift.ok and drift.reason == "canary_drift"
+        # No drift budget -> arbitrary (finite, bounded) movement commits.
+        assert canary_checks(z, (np.ones((1, 2)), np.zeros((1, 2)))).ok
+
+
+def _save_ckpt(d, spec, params, epoch):
+    from masters_thesis_tpu.train.checkpoint import save_checkpoint
+
+    save_checkpoint(
+        Path(d), "best", params, {}, spec,
+        meta={"epoch": epoch, "datamodule": {"lookback_window": T}},
+    )
+
+
+@pytest.fixture
+def swap_setup(tmp_path):
+    """Engine booted from a published checkpoint (the serving boot path,
+    strict verification) plus the directory new candidates land in."""
+    from masters_thesis_tpu.serve.engine import PredictEngine
+
+    spec = _tiny_spec()
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    _save_ckpt(d, spec, _init_params(spec, seed=0), epoch=0)
+    engine = PredictEngine.from_checkpoint(
+        d, "best", n_stocks=K, n_features=F, buckets=(1,)
+    )
+    engine.warmup()
+    return d, spec, engine
+
+
+class TestCheckpointSwap:
+    def test_good_candidate_commits(self, swap_setup):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        d, spec, engine = swap_setup
+        swapper = CheckpointSwapper(engine)
+        golden = swapper.golden_x
+        before = engine.predict(golden)
+        _save_ckpt(d, spec, _init_params(spec, seed=7), epoch=1)
+        verdict = swapper.try_swap(d)
+        assert verdict.ok and verdict.reason == "committed"
+        assert swapper.committed == 1 and swapper.rejected == 0
+        after = engine.predict(golden)
+        # Different params now serve: outputs moved.
+        assert not np.allclose(before[0], after[0])
+
+    def test_corrupt_candidate_refused_with_output_parity(
+        self, swap_setup, tmp_path
+    ):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+        from masters_thesis_tpu.telemetry import TelemetryRun
+        from masters_thesis_tpu.telemetry.report import summarize_events
+
+        d, spec, engine = swap_setup
+        tel = TelemetryRun(tmp_path / "tel", run_id="swap-chaos")
+        swapper = CheckpointSwapper(engine, telemetry=tel)
+        before = engine.predict(swapper.golden_x)
+        _save_ckpt(d, spec, _init_params(spec, seed=7), epoch=1)
+        faults.install_plan(
+            FaultPlan(
+                faults=(
+                    FaultSpec(
+                        point="serve.pre_swap", kind="corrupt", attempt=None
+                    ),
+                ),
+                seed=5,
+            )
+        )
+        try:
+            verdict = swapper.try_swap(d)
+        finally:
+            faults.clear_plan()
+        tel.close()
+        assert not verdict.ok and verdict.reason == "verify_failed"
+        assert swapper.rejected == 1 and swapper.committed == 0
+        # The replica keeps serving the EXACT old params.
+        after = engine.predict(swapper.golden_x)
+        assert np.array_equal(before[0], after[0])
+        assert np.array_equal(before[1], after[1])
+        # Distinct signature in telemetry summarize.
+        report = summarize_events(_events(tel))
+        assert report["serve"]["swaps_rejected"] == 1
+        assert report["serve"]["swaps_committed"] == 0
+
+    def test_manifestless_candidate_refused(self, swap_setup):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+        from masters_thesis_tpu.train.checkpoint import (
+            MANIFEST_NAME,
+            verify_checkpoint,
+        )
+
+        d, spec, engine = swap_setup
+        _save_ckpt(d, spec, _init_params(spec, seed=7), epoch=1)
+        (d / "best" / MANIFEST_NAME).unlink()
+        assert verify_checkpoint(d / "best")  # training would accept it
+        verdict = CheckpointSwapper(engine).try_swap(d)
+        assert not verdict.ok and verdict.reason == "verify_failed"
+
+    def test_shape_mismatch_refused(self, swap_setup):
+        from masters_thesis_tpu.models.objectives import ModelSpec
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        d, _, engine = swap_setup
+        wide = ModelSpec(
+            objective="mse", hidden_size=16, num_layers=1, dropout=0.0,
+            kernel_impl="xla",
+        )
+        module = wide.build_module()
+        import jax
+        import jax.numpy as jnp
+
+        params = module.init(
+            jax.random.key(0), jnp.zeros((1, T, F), jnp.float32)
+        )["params"]
+        _save_ckpt(d, wide, params, epoch=1)
+        verdict = CheckpointSwapper(engine).try_swap(d)
+        assert not verdict.ok and verdict.reason == "shape_mismatch"
+
+
+# ------------------------------------------------------------- chaos suite
+
+
+class TestChaosServer:
+    def test_overload_sheds_explicitly_never_late(
+        self, shared_engine, tmp_path
+    ):
+        from masters_thesis_tpu.serve.server import PredictServer
+        from masters_thesis_tpu.telemetry import TelemetryRun
+        from masters_thesis_tpu.telemetry.report import summarize_events
+
+        tel = TelemetryRun(tmp_path / "tel", run_id="overload-chaos")
+        server = PredictServer(
+            shared_engine, telemetry=tel, max_wait_s=0.002
+        )
+        server.start()
+        feasible = [
+            server.submit(shared_engine.golden_batch(1, seed=i)[0], 10.0)
+            for i in range(10)
+        ]
+        # Zero budget: the admission forecast can never fit, every one of
+        # these must be shed explicitly (not queued, not answered late).
+        hopeless = [
+            server.submit(
+                shared_engine.golden_batch(1, seed=i)[0], deadline_s=0.0
+            )
+            for i in range(30)
+        ]
+        results_ok = [p.result(timeout=60.0) for p in feasible]
+        results_shed = [p.result(timeout=60.0) for p in hopeless]
+        stats = server.stop()
+        tel.close()
+
+        assert all(r.status == STATUS_OK for r in results_ok)
+        assert all(r.status == STATUS_SHED for r in results_shed)
+        assert all("deadline infeasible" in r.detail for r in results_shed)
+        # The no-late-answers contract, checked from the caller's side.
+        assert not any(
+            r.ok and r.delivered_ts > p.request.deadline_ts
+            for p, r in zip(feasible, results_ok)
+        )
+        assert stats["shed"] == 30 and stats["completed"] == 10
+        assert stats["late_deliveries"] == 0
+
+        report = summarize_events(_events(tel))
+        assert report["serve"]["shed"] == 30
+        assert report["serve"]["clean_stop"]
+        assert report["serve"]["p99_ms"] is not None
+        assert report["violations"] == []
+
+    def test_nan_fault_withholds_outputs(self, shared_engine):
+        from masters_thesis_tpu.serve.server import PredictServer
+
+        faults.install_plan(
+            FaultPlan(
+                faults=(
+                    FaultSpec(
+                        point="serve.dispatch", kind="nan", attempt=None
+                    ),
+                )
+            )
+        )
+        server = PredictServer(shared_engine, max_wait_s=0.001)
+        server.start()
+        r = server.submit(
+            shared_engine.golden_batch(1)[0], deadline_s=10.0
+        ).result(timeout=30.0)
+        server.stop()
+        assert r.status == STATUS_ERROR and "non-finite" in r.detail
+        assert r.outputs is None
+
+    def test_wedge_degrades_to_cpu_after_one_probe(self, tmp_path):
+        from masters_thesis_tpu.serve.server import (
+            InjectedDeviceError,  # noqa: F401 — the error the wedge raises
+            PredictServer,
+        )
+        from masters_thesis_tpu.telemetry import TelemetryRun
+        from masters_thesis_tpu.telemetry.report import summarize_events
+        from masters_thesis_tpu.utils.backend_probe import BackendHealth
+
+        engine = _make_engine(buckets=(1, 2))
+        tel = TelemetryRun(tmp_path / "tel", run_id="wedge-chaos")
+        health = BackendHealth(tmp_path / "probe_cache.json", timeout_s=5.0)
+        # Dispatches 0 and 1 hit a device error; the backend probe itself
+        # is wedged, so the tripped breaker must degrade to CPU.
+        faults.install_plan(
+            FaultPlan(
+                faults=(
+                    FaultSpec(
+                        point="serve.dispatch", kind="wedge",
+                        attempt=None, match={"seq": 0},
+                    ),
+                    FaultSpec(
+                        point="serve.dispatch", kind="wedge",
+                        attempt=None, match={"seq": 1},
+                    ),
+                    FaultSpec(
+                        point="probe.attempt", kind="wedge", attempt=None
+                    ),
+                )
+            )
+        )
+        server = PredictServer(
+            engine, telemetry=tel, health=health, breaker_threshold=2,
+            max_wait_s=0.001,
+        )
+        server.start()
+        x = engine.golden_batch(1)[0]
+        # Sequential submits: each scripted failure is its own dispatch,
+        # so exactly two consecutive failures reach the breaker.
+        for _ in range(2):
+            r = server.submit(x, deadline_s=30.0).result(timeout=60.0)
+            assert r.status == STATUS_ERROR
+            assert "InjectedDeviceError" in r.detail
+        deadline = time.monotonic() + 120.0
+        while server.degradations < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        after = server.submit(x, deadline_s=30.0).result(timeout=60.0)
+        stats = server.stop()
+        tel.close()
+
+        assert after.status == STATUS_OK  # traffic recovered on CPU
+        assert stats["degradations"] == 1 and stats["errors"] == 2
+        assert stats["late_deliveries"] == 0
+        assert engine.platform == "cpu"
+        events = _events(tel)
+        degr = [e for e in events if e.get("kind") == "degradation"]
+        assert len(degr) == 1
+        assert degr[0]["scope"] == "serve"
+        # Exactly ONE probe: single_attempt=True forces budget 0.
+        assert degr[0]["probe_attempts"] == 1
+        report = summarize_events(events)
+        assert report["serve"]["degradations"] == 1
+        assert report["violations"] == []
+
+
+# ------------------------------------------------------ summarize contract
+
+
+class TestServeTelemetryContract:
+    def test_no_serve_section_without_serve_events(self):
+        from masters_thesis_tpu.telemetry.report import summarize_events
+
+        assert summarize_events([])["serve"] is None
+
+    def test_late_delivery_is_a_contract_violation(self):
+        from masters_thesis_tpu.telemetry.report import summarize_events
+
+        report = summarize_events(
+            [
+                {"kind": "serve_started"},
+                {
+                    "kind": "serve_finished",
+                    "requests": 5,
+                    "completed": 5,
+                    "late_deliveries": 2,
+                },
+            ]
+        )
+        assert any("delivered past" in v for v in report["violations"])

@@ -40,6 +40,16 @@ echo "== resilience classify (unit) =="
 python -m masters_thesis_tpu.resilience classify --rc -15 \
     | grep '"kind": "transient"' >/dev/null || fail=1
 
+# 3c. serving: jax-free smoke of the request path (queue/admission/
+#     deadline/breaker/canary with a fake engine), then the serve
+#     preflight on the hermetic 8-device virtual CPU mesh — every predict
+#     bucket compiles exactly once and the hot path is clean under
+#     transfer_guard("disallow") (rules SV301-SV303).
+echo "== serve selfcheck =="
+python -m masters_thesis_tpu.serve selfcheck || fail=1
+echo "== serve preflight =="
+JAX_PLATFORMS=cpu python -m masters_thesis_tpu.serve preflight || fail=1
+
 if [ "${1:-}" = "--fast" ]; then
     exit $fail
 fi
