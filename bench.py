@@ -161,6 +161,10 @@ def _make_trainer(
         enable_model_summary=False,
         seed=0,
         telemetry=telemetry,
+        # Static cost model of the hot program (telemetry/costs.py): every
+        # measured point reports FLOPs/step + bytes/step + utilization and
+        # lands one row in results/perf_ledger.jsonl.
+        cost_profile=True,
     )
 
 
@@ -179,13 +183,33 @@ def _point_telemetry(objective: str, batch_size: int):
     return TelemetryRun(Path(root) / f"point_{objective}_bs{batch_size}")
 
 
-def _measure(dm, objective: str, measure_epochs: int, telemetry=None) -> float:
-    """steps/sec for one (datamodule, objective) point; compile excluded."""
+def _measure(dm, objective: str, measure_epochs: int, telemetry=None):
+    """(steps/sec, cost payload|None) for one (datamodule, objective)
+    point; compile excluded from the timing, the cost model extracted from
+    the very executable that ran."""
     from masters_thesis_tpu.models.objectives import ModelSpec
 
     spec = ModelSpec(objective=objective)  # model=small defaults
     result = _make_trainer(measure_epochs, telemetry=telemetry).fit(spec, dm)
-    return result.steps_per_sec
+    return result.steps_per_sec, result.cost_profile
+
+
+def _cost_with_utilization(cost: dict | None, sps: float, platform: str):
+    """Attach roofline numbers to a point's static cost payload: achieved
+    FLOP/s and bytes/s follow from the MEASURED steps/sec, so this is the
+    one place static compiler counters meet wall-clock throughput."""
+    if not cost or not cost.get("available"):
+        return cost
+    from masters_thesis_tpu.telemetry.costs import utilization
+
+    out = dict(cost)
+    out["utilization"] = utilization(
+        cost.get("flops_per_step"),
+        cost.get("bytes_per_step"),
+        sps,
+        platform,
+    )
+    return out
 
 
 def _scaling_child() -> None:
@@ -414,20 +438,25 @@ def _point_child(objective: str, batch_size: int, epochs: int) -> None:
             rec.beat(phase="point")
     # With telemetry on, Trainer.fit attaches the recorder to tel's run dir
     # itself (telemetry/run.py attach_flight_recorder is idempotent).
-    sps = _measure(dm, objective, epochs, telemetry=tel)
+    sps, cost = _measure(dm, objective, epochs, telemetry=tel)
     if rec is not None:
         rec.close()
     if tel is not None:
         tel.close()
     import jax
 
+    platform = jax.devices()[0].platform
     print(json.dumps({
         "steps_per_sec": sps,
-        "platform": jax.devices()[0].platform,
+        "platform": platform,
         "windows_per_epoch": len(dm.train_range),
         "pack_width": _point_pack_width(batch_size, objective),
         "grad_sync": _grad_sync_stats(objective),
         "telemetry": None if tel is None else str(tel.run_dir),
+        # Static cost model + roofline attribution for this measured point
+        # (None when the backend reports no cost model — the parent still
+        # writes a ledger row from the measured steps/sec alone).
+        "cost": _cost_with_utilization(cost, sps, platform),
     }))
 
 
@@ -666,6 +695,67 @@ def _serve_bench() -> int:
     return 0
 
 
+def _detail_cost(cost: dict | None) -> dict | None:
+    """The JSON-line's `detail.cost`: the roofline essentials of the
+    headline point (full payloads live in the ledger/telemetry stream)."""
+    if not cost:
+        return None
+    util = cost.get("utilization") or {}
+    return {
+        "program": cost.get("program"),
+        "available": cost.get("available"),
+        "flops_per_step": cost.get("flops_per_step"),
+        "bytes_per_step": cost.get("bytes_per_step"),
+        "peak_memory_bytes": cost.get("peak_bytes"),
+        "arithmetic_intensity": util.get("arithmetic_intensity"),
+        "flops_utilization_pct": util.get("flops_utilization_pct"),
+        "regime": util.get("regime"),
+    }
+
+
+def _append_perf_ledger(points: list[tuple[str, int, dict]]) -> str | None:
+    """One schema-versioned row per successful measured point, appended to
+    results/perf_ledger.jsonl under a shared round id (MTT_BENCH_ROUND or
+    this run's timestamp). Ledger I/O must never cost the run its JSON
+    line — any failure is logged to stderr and swallowed."""
+    if not points:
+        return None
+    try:
+        from masters_thesis_tpu.telemetry.ledger import (
+            DEFAULT_LEDGER_PATH,
+            append_record,
+            ledger_record,
+        )
+
+        path = Path(__file__).resolve().parent / DEFAULT_LEDGER_PATH
+        round_id = os.environ.get("MTT_BENCH_ROUND") or time.strftime(
+            "%Y%m%dT%H%M%S"
+        )
+        for objective, batch_size, point in points:
+            cost = point.get("cost") or {}
+            util = cost.get("utilization") or {}
+            meta = cost.get("meta") or {}
+            append_record(path, ledger_record(
+                point=f"{objective}/bs={batch_size}",
+                round_id=round_id,
+                platform=point.get("platform"),
+                steps_per_sec=point.get("steps_per_sec"),
+                objective=objective,
+                batch_size=batch_size,
+                mesh_shape=meta.get("mesh_shape"),
+                pack_width=point.get("pack_width"),
+                flops_per_step=cost.get("flops_per_step"),
+                bytes_per_step=cost.get("bytes_per_step"),
+                peak_memory_bytes=cost.get("peak_bytes"),
+                utilization_pct=util.get("flops_utilization_pct"),
+                regime=util.get("regime"),
+            ))
+        return str(path)
+    except Exception as exc:  # noqa: BLE001 — observability, not the bench
+        print(f"perf ledger append failed: {exc!r}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
     if "--telemetry-dir" in sys.argv:
         # Export before the first watchdog child spawns: points write their
@@ -711,6 +801,10 @@ def main() -> None:
     # child's flight recorder) survive into detail.failures — the driver's
     # per-round capture previously recorded such deaths as `"tail": ""`.
     failures: list[dict] = []
+    # Every successful measured point lands one append-only row in
+    # results/perf_ledger.jsonl (objective, batch_size, point record);
+    # `python -m masters_thesis_tpu.telemetry ledger` diffs rounds.
+    ledger_points: list[tuple[str, int, dict]] = []
 
     def collect(point: dict | None) -> dict | None:
         if point is not None and point.get("failed"):
@@ -753,6 +847,8 @@ def main() -> None:
             platform = point["platform"]
             grad_sync = point.get("grad_sync")
             pack_widths["1"] = point.get("pack_width", 1)
+            headline_cost = point.get("cost")
+            ledger_points.append(("mse", 1, point))
         else:
             _pin_cpu_in_process()
             dm1 = FinancialWindowDataModule(
@@ -761,19 +857,26 @@ def main() -> None:
             )
             dm1.prepare_data(verbose=False)
             dm1.setup()
-            value = _measure(dm1, "mse", measure_epochs)
+            value, in_cost = _measure(dm1, "mse", measure_epochs)
             windows_per_epoch = len(dm1.train_range)
             import jax
 
             platform = jax.devices()[0].platform
             grad_sync = _grad_sync_stats("mse")
             pack_widths["1"] = 1
+            headline_cost = _cost_with_utilization(in_cost, value, platform)
+            ledger_points.append(("mse", 1, {
+                "steps_per_sec": value, "platform": platform,
+                "pack_width": 1, "cost": headline_cost,
+            }))
     else:
         value = headline["steps_per_sec"]
         windows_per_epoch = headline["windows_per_epoch"]
         platform = headline["platform"]
         grad_sync = headline.get("grad_sync")
         pack_widths["1"] = headline.get("pack_width", 1)
+        headline_cost = headline.get("cost")
+        ledger_points.append(("mse", 1, headline))
 
     # Degraded (wedged relay, CPU fallback): the probe/watchdog already
     # burned its budget — measure ONLY the headline point so the one JSON
@@ -788,6 +891,7 @@ def main() -> None:
                                        POINT_TIMEOUT_AUX_S))
         if _point_ok(point):
             nll_sps = point["steps_per_sec"]
+            ledger_points.append(("nll", 1, point))
         # Batch sweep: amortizing the per-step dispatch floor. windows/sec
         # = steps/sec * batch_size, comparable across points.
         for bs in (8, 32):
@@ -796,6 +900,7 @@ def main() -> None:
             if _point_ok(point):
                 batch_sweep[str(bs)] = round(point["steps_per_sec"] * bs, 2)
                 pack_widths[str(bs)] = point.get("pack_width")
+                ledger_points.append(("mse", bs, point))
         scaling = _run_scaling_subprocess()
     wall = time.perf_counter() - t0
 
@@ -851,6 +956,10 @@ def main() -> None:
             "scaling_fixed_global_batch": (
                 scaling.get("strong_fixed_global_batch") if scaling else None
             ),
+            # Headline point's static cost model + roofline attribution
+            # (telemetry/costs.py); full per-point rows go to the ledger.
+            "cost": _detail_cost(headline_cost),
+            "perf_ledger": _append_perf_ledger(ledger_points),
             "failures": failures,
         },
     }

@@ -76,6 +76,28 @@ RULES: dict[str, tuple[str, str]] = {
         "serve preflight could not run to completion",
         "infrastructure",
     ),
+    "SV304": (
+        "serve bucket peak memory (memory_analysis) exceeds the backend's "
+        "reported device memory — the bucket would OOM at first request",
+        "memory",
+    ),
+    # CP4xx: cost & utilization observability (telemetry/costs.py) — static
+    # cost models from compiled executables plus roofline attribution.
+    "CP401": (
+        "cost model unavailable: the backend reported no cost_analysis for "
+        "a hot program, so utilization/roofline gauges are flying blind",
+        "infrastructure",
+    ),
+    "CP402": (
+        "compiled-program peak memory exceeds the device memory budget",
+        "memory",
+    ),
+    "CP403": (
+        "achieved FLOP/s below the utilization floor on a real TPU backend "
+        "(the program cannot feed the MXU; see docs/telemetry.md roofline "
+        "playbook)",
+        "utilization",
+    ),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -64,19 +64,16 @@ SINGLE_TILE_MAX_ROWS = 104
 ROW_TILE = 32
 
 
-def single_layer_fits(
+def _single_layer_vmem_bytes(
     n_t: int, b: int, hidden: int, itemsize: int = 4
-) -> bool:
-    """VMEM feasibility of the single-layer kernel at (T, rows, H).
+) -> int:
+    """VMEM footprint of the single-layer BACKWARD program, in bytes.
 
     The backward program is the high-water mark: per row-tile it holds the
     x/dx aliased ``(T, tile, 4H)`` plane, the dh cotangent and h/c stashes
     (3 ``(T, tile, H)`` planes), the weight and its grad, and the f32
     scratch — doubled when the row grid pipelines more than one tile
-    (Pallas double-buffers blocked refs across grid steps). Long lookbacks
-    blow this budget no matter the row tile; callers must fall back to the
-    time-blocked kernel or the scan formulation instead of hitting a
-    Mosaic scoped-VMEM compile error.
+    (Pallas double-buffers blocked refs across grid steps).
     """
     four_h = 4 * hidden
     tile = _row_tile(b)
@@ -90,7 +87,19 @@ def single_layer_fits(
         planes = n_t * tile * (2 * four_h + 3 * hidden) * 2
     scratch = 2 * tile * hidden + hidden * four_h
     weights = 2 * hidden * four_h
-    return (planes + weights) * itemsize + scratch * 4 <= _PAIR_VMEM_BUDGET
+    return (planes + weights) * itemsize + scratch * 4
+
+
+def single_layer_fits(
+    n_t: int, b: int, hidden: int, itemsize: int = 4
+) -> bool:
+    """VMEM feasibility of the single-layer kernel at (T, rows, H).
+
+    Long lookbacks blow the budget no matter the row tile; callers must
+    fall back to the time-blocked kernel or the scan formulation instead
+    of hitting a Mosaic scoped-VMEM compile error.
+    """
+    return _single_layer_vmem_bytes(n_t, b, hidden, itemsize) <= _PAIR_VMEM_BUDGET
 
 
 def _fallback_row_tile() -> int:
@@ -1487,18 +1496,22 @@ def lstm_stack_recurrence(
     w_hh_ts, w_in_ts, biases = (tuple(part) for part in weights)
     weights = (w_hh_ts, w_in_ts, biases)
     masks = None if masks is None else tuple(masks)
-    if impl == "auto":
+    # TL102 suppressions below: `impl` and the shape ints are static host
+    # config, never tracers — the taint analysis only flags them because
+    # cost profiling (telemetry/costs.py lstm_route_cost) jits this
+    # dispatcher directly, making its params look trace-reachable.
+    if impl == "auto":  # tracelint: disable=TL102
         impl = (
             "xla"
             if os.environ.get("MT_TPU_DISABLE_PALLAS")
-            else ("pallas" if jax.default_backend() == "tpu" else "xla")
+            else ("pallas" if jax.default_backend() == "tpu" else "xla")  # tracelint: disable=TL102
         )
     ell = len(w_hh_ts)
     n_t, batch = x1_proj.shape[0], x1_proj.shape[1]
     hidden = w_hh_ts[0].shape[0]
     itemsize = jnp.dtype(x1_proj.dtype).itemsize
     has_mask = masks is not None
-    if impl in ("pallas", "interpret") and not stack_fits(
+    if impl in ("pallas", "interpret") and not stack_fits(  # tracelint: disable=TL102
         n_t, batch, hidden, ell, has_mask, itemsize
     ):
         if window_schedulable(batch, window_rows) and stack_fits(
@@ -1530,9 +1543,9 @@ def lstm_stack_recurrence(
                 *masks,
             )
         impl = "xla"
-    if impl in ("pallas", "interpret"):
+    if impl in ("pallas", "interpret"):  # tracelint: disable=TL102
         return _lstm_stack_pallas(x1_proj, weights, masks, impl == "interpret")
-    if impl == "xla":
+    if impl == "xla":  # tracelint: disable=TL102
         return lstm_stack_xla(x1_proj, weights, masks)
     raise ValueError(f"unknown lstm impl: {impl!r}")
 
@@ -1695,7 +1708,9 @@ def window_pack_width(b: int, window_rows: int | None, fits) -> int:
     n_windows = b // window_rows
     best = 1
     for p in range(2, n_windows + 1):
-        if n_windows % p == 0 and fits(p * window_rows):
+        # Static host-side scheduling math (ints); flagged only because
+        # cost profiling jits the dispatchers that call this.
+        if n_windows % p == 0 and fits(p * window_rows):  # tracelint: disable=TL102
             best = p
     return best
 
@@ -1795,3 +1810,94 @@ def lstm_recurrence(
     if impl == "xla":
         return lstm_recurrence_xla(x_proj, w_hh_t)
     raise ValueError(f"unknown lstm impl: {impl!r}")
+
+
+def route_plan(
+    n_t: int,
+    b: int,
+    hidden: int,
+    n_layers: int = 2,
+    *,
+    has_mask: bool = False,
+    itemsize: int = 4,
+    window_rows: int | None = None,
+    backend: str | None = None,
+) -> dict:
+    """The routing decision the recurrence dispatchers would take, as data.
+
+    Mirrors the ``impl="auto"`` predicates of :func:`lstm_recurrence`
+    (``n_layers == 1``) and :func:`lstm_stack_recurrence` (deeper stacks)
+    without building any program: which implementation runs at this shape
+    on this backend, how many windows pack per Pallas program, and what
+    the VMEM byte model predicts for the per-program footprint next to the
+    budget it is held against. Telemetry (``telemetry/costs.py``) emits
+    this plan alongside the compiler-reported actual temp bytes so the
+    byte model stays auditable against the compiler instead of trusted
+    blindly. ``backend=None`` reads the live default backend.
+    """
+    if backend is None:
+        backend = jax.default_backend()
+    pallas = backend == "tpu" and not os.environ.get("MT_TPU_DISABLE_PALLAS")
+    b_pad = -(-b // 8) * 8
+    plan = {
+        "n_t": n_t,
+        "rows": b,
+        "rows_padded": b_pad,
+        "hidden": hidden,
+        "n_layers": n_layers,
+        "has_mask": has_mask,
+        "itemsize": itemsize,
+        "window_rows": window_rows,
+        "backend": backend,
+        "vmem_budget_bytes": _PAIR_VMEM_BUDGET,
+        "pack_width": 1,
+    }
+    if n_layers == 1:
+        fits = lambda rows: single_layer_fits(n_t, rows, hidden, itemsize)  # noqa: E731
+        rows_per_program = b
+        if not pallas:
+            route = "xla-scan"
+        elif (
+            b_pad > SINGLE_TILE_MAX_ROWS
+            and window_schedulable(b, window_rows)
+            and -(-window_rows // 8) * 8 <= SINGLE_TILE_MAX_ROWS
+            and fits(window_rows)
+        ):
+            route = "pallas-packed"
+            plan["pack_width"] = window_pack_width(
+                b,
+                window_rows,
+                lambda rows: -(-rows // 8) * 8 <= SINGLE_TILE_MAX_ROWS
+                and fits(rows),
+            )
+            rows_per_program = plan["pack_width"] * window_rows
+        elif fits(b):
+            route = "pallas-single"
+        else:
+            route = "pallas-timeblocked"
+        predicted = _single_layer_vmem_bytes(n_t, rows_per_program, hidden,
+                                             itemsize)
+    else:
+        fits = lambda rows: stack_fits(  # noqa: E731
+            n_t, rows, hidden, n_layers, has_mask, itemsize
+        )
+        rows_per_program = b
+        if not pallas:
+            route = "xla-scan"
+        elif fits(b):
+            route = "pallas-resident"
+        elif window_schedulable(b, window_rows) and fits(window_rows):
+            route = "pallas-packed"
+            plan["pack_width"] = window_pack_width(b, window_rows, fits)
+            rows_per_program = plan["pack_width"] * window_rows
+        else:
+            route = "xla-scan"  # stack budget blown at every window shape
+        predicted = _stack_bwd_vmem_bytes(
+            n_t, -(-rows_per_program // 8) * 8, hidden, n_layers, has_mask,
+            itemsize,
+        )
+    plan["route"] = route
+    plan["rows_per_program"] = rows_per_program
+    plan["predicted_vmem_bytes"] = predicted
+    plan["fits"] = predicted <= _PAIR_VMEM_BUDGET
+    return plan

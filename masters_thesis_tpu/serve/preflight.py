@@ -15,6 +15,11 @@ window of varied batch sizes and asserts the serving contract:
   ``device_put``/``device_get`` only; any implicit host touch raises.
 - **SV303** — the preflight itself failed to run (infrastructure — a red
   check, never a silent green).
+- **SV304** — memory admission: every bucket executable's
+  ``memory_analysis()`` peak bytes must fit the backend's reported device
+  memory, so an OOM-bound bucket config is refused here instead of at the
+  first live request. Skipped (not failed) when the backend reports no
+  budget (the virtual CPU mesh).
 
 Sized to run in seconds on the 8-device virtual CPU mesh; the invariants
 are properties of the compiled programs, not of the backend.
@@ -100,6 +105,28 @@ def _run(spec, mesh, buckets, requests) -> list[Finding]:
                 f"{engine.buckets} (expected exactly one per bucket)",
             )
         )
+
+    # SV304 — memory admission: hold every bucket's compiler-reported peak
+    # bytes against the device memory budget. No budget reported (virtual
+    # CPU mesh) = no check; a missing profile is CP401's department, not a
+    # serve failure.
+    from masters_thesis_tpu.telemetry.costs import device_memory_budget
+
+    budget = device_memory_budget(engine.mesh)
+    if budget:
+        for b in engine.buckets:
+            payload = engine.cost_profiles.get(b) or {}
+            peak = payload.get("peak_bytes")
+            if peak is not None and peak > budget:
+                findings.append(
+                    Finding(
+                        rule="SV304",
+                        message=f"bucket {b} peak memory {peak} bytes "
+                        f"exceeds the device budget {budget} bytes — this "
+                        "bucket would OOM at first request; shrink the "
+                        "bucket or the model before serving",
+                    )
+                )
 
     # Steady-state window: request sizes sweep every bucket boundary
     # (exact fits and pad-to-bucket), inputs pre-generated on the host.

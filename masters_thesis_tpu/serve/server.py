@@ -127,6 +127,21 @@ class PredictServer:
             warmup_batch_ms=warm_s * 1e3,
             compile_events=self.engine.compile_events,
         )
+        # One cost_profile event per bucket executable (extracted by the
+        # engine at compile time, so this is pure event I/O): FLOPs, bytes
+        # accessed, peak memory — the summarize CLI's utilization section
+        # and preflight SV304 both read these numbers.
+        warned = False
+        # getattr: injected fake engines (selfcheck CLI) carry no profiles.
+        profiles = getattr(self.engine, "cost_profiles", {})
+        for b in self.engine.buckets:
+            payload = profiles.get(b)
+            if payload:
+                self._event("cost_profile", **payload)
+            elif not warned:  # warn-once; summarize renders "n/a"
+                warned = True
+                self._event("cost_unavailable",
+                            program=f"serve_bucket_{b}")
         self._thread = threading.Thread(
             target=self._worker, name="serve-dispatch", daemon=True
         )

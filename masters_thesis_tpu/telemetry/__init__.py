@@ -19,9 +19,16 @@ a program SHOULD do; this package measures what runs actually DO:
   heartbeat files the fleet aggregator reads past a SIGKILL;
 - :mod:`aggregate` — cross-host stream merging: per-host epoch-time skew,
   collective wait attribution, stragglers, exit-status reconstruction;
+- :mod:`costs`    — static cost models of compiled executables
+  (``cost_analysis()`` + ``memory_analysis()``) and roofline attribution
+  (achieved FLOP/s vs nominal peaks, compute/memory/comms-bound regime);
+  pure math importable without jax, extraction lazy;
+- :mod:`ledger`   — append-only ``results/perf_ledger.jsonl`` of measured
+  bench points (stdlib-only) + round-over-round regression diffing;
 - :mod:`report` + ``__main__`` — ``python -m masters_thesis_tpu.telemetry
-  summarize|aggregate|postmortem <run>``: single-run reports and fleet
-  postmortems; exit nonzero on contract violations / dead processes.
+  summarize|aggregate|postmortem|ledger <run>``: single-run reports, fleet
+  postmortems, and perf-ledger diffs; exit nonzero on contract violations
+  / dead processes / >15% utilization or throughput regressions.
 
 Event schema and metric taxonomy: docs/telemetry.md.
 """
@@ -30,7 +37,20 @@ from masters_thesis_tpu.telemetry.aggregate import (
     aggregate_path,
     postmortem_path,
 )
+from masters_thesis_tpu.telemetry.costs import (
+    CostModel,
+    extract_cost,
+    profile_jit,
+    roofline_regime,
+    utilization,
+)
 from masters_thesis_tpu.telemetry.events import EventSink, read_events
+from masters_thesis_tpu.telemetry.ledger import (
+    append_record,
+    ledger_diff,
+    ledger_record,
+    read_ledger,
+)
 from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
 from masters_thesis_tpu.telemetry.profiling import ProfilerWindow
 from masters_thesis_tpu.telemetry.registry import (
@@ -48,6 +68,7 @@ from masters_thesis_tpu.telemetry.run import (
 
 __all__ = [
     "CompileTracker",
+    "CostModel",
     "Counter",
     "EpochRecorder",
     "EventSink",
@@ -58,7 +79,15 @@ __all__ = [
     "ProfilerWindow",
     "TelemetryRun",
     "aggregate_path",
+    "append_record",
     "device_memory_snapshot",
+    "extract_cost",
+    "ledger_diff",
+    "ledger_record",
     "postmortem_path",
+    "profile_jit",
     "read_events",
+    "read_ledger",
+    "roofline_regime",
+    "utilization",
 ]

@@ -15,6 +15,12 @@ Subcommands:
   when any process died, hung, or stalled — so sweep runners and CI can
   gate on it. ``--selfcheck`` runs a hermetic simulated-fleet smoke
   instead (the tools/check.sh gate).
+- ``ledger [path]`` — diff the latest perf-ledger round
+  (``results/perf_ledger.jsonl``, appended by ``bench.py``) against the
+  baseline window at equal config. Exit codes: 0 = ok / nothing to gate,
+  1 = could not load, 2 = >threshold steps/s or utilization regression.
+  ``--selfcheck`` fabricates a two-round ledger and verifies the gate
+  fires (the tools/check.sh gate).
 - ``selfcheck`` — hermetic smoke of the whole pipeline (registry ->
   events -> report) in a temp dir; the tools/check.sh telemetry gate.
 
@@ -179,6 +185,92 @@ def _postmortem_selfcheck() -> int:
     return 0
 
 
+def _ledger(args) -> int:
+    if args.selfcheck:
+        return _ledger_selfcheck()
+    from masters_thesis_tpu.telemetry.ledger import (
+        diff_path,
+        render_ledger_text,
+    )
+    from pathlib import Path
+
+    path = Path(args.path)
+    if not path.exists():
+        print(f"ledger: {path} does not exist", file=sys.stderr)
+        return 1
+    report = diff_path(
+        path, threshold_pct=args.threshold, baseline_rounds=args.baseline
+    )
+    print(
+        json.dumps(report, indent=2, default=str)
+        if args.json
+        else render_ledger_text(report)
+    )
+    return 2 if report["regressed"] else 0
+
+
+def _ledger_selfcheck() -> int:
+    """Hermetic smoke of the perf-ledger gate: fabricate a steady
+    two-round ledger (must pass) and a third round 30% slower at equal
+    config (the gate must fire). Jax-free — the tools/check.sh gate."""
+    from pathlib import Path
+
+    from masters_thesis_tpu.telemetry.ledger import (
+        append_record,
+        diff_path,
+        ledger_record,
+        read_ledger,
+    )
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "perf_ledger.jsonl"
+
+        def point(round_id, sps, util, ts):
+            return ledger_record(
+                point="scan_bs2", round_id=round_id, platform="cpu",
+                steps_per_sec=sps, batch_size=2, mesh_shape=[8],
+                pack_width=4, objective="mse", flops_per_step=1.6e5,
+                bytes_per_step=7.2e5, utilization_pct=util,
+                regime="memory-bound", rev="deadbee", ts=ts,
+            )
+
+        append_record(path, point("r1", 100.0, 4.0, 1.0))
+        append_record(path, point("r2", 98.0, 3.9, 2.0))
+        if len(read_ledger(path)) != 2:
+            failures.append("append/read round-trip lost rows")
+        report = diff_path(path)
+        if report["regressed"] or report["rounds"] != 2:
+            failures.append(f"steady ledger flagged regressed: {report}")
+        if not report["compared"]:
+            failures.append("equal-config rounds were not compared")
+
+        append_record(path, point("r3", 60.0, 2.4, 3.0))
+        report = diff_path(path)
+        if not report["regressed"]:
+            failures.append("30% slower round did not trip the gate")
+        else:
+            metrics = report["regressions"][0]["regressed_metrics"]
+            if set(metrics) != {"steps_per_sec", "utilization_pct"}:
+                failures.append(f"unexpected regressed metrics: {metrics}")
+
+        # A config change (different batch size) must NOT be compared
+        # against the old baseline — no false regression.
+        path2 = Path(tmp) / "drift.jsonl"
+        append_record(path2, point("r1", 100.0, 4.0, 1.0))
+        rec = point("r2", 10.0, 0.4, 2.0)
+        rec["batch_size"] = 64
+        append_record(path2, rec)
+        report = diff_path(path2)
+        if report["regressed"] or not report["new_configs"]:
+            failures.append(f"config drift mis-gated: {report}")
+    if failures:
+        print("telemetry: ledger selfcheck FAILED: " + "; ".join(failures))
+        return 1
+    print("telemetry: ledger selfcheck ok")
+    return 0
+
+
 def _selfcheck(args) -> int:
     from masters_thesis_tpu.telemetry.report import summarize_path
     from masters_thesis_tpu.telemetry.run import TelemetryRun
@@ -265,6 +357,31 @@ def main(argv: list[str] | None = None) -> int:
         help="hermetic simulated-fleet smoke instead of reading a run",
     )
     p_post.set_defaults(fn=_postmortem)
+    p_led = sub.add_parser(
+        "ledger",
+        help="diff the perf ledger's latest round vs baseline; exit 2 "
+             "on >threshold regression at equal config",
+    )
+    p_led.add_argument(
+        "path", nargs="?", default="results/perf_ledger.jsonl",
+        help="perf ledger JSONL (default: results/perf_ledger.jsonl)",
+    )
+    p_led.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p_led.add_argument(
+        "--threshold", type=float, default=15.0, metavar="PCT",
+        help="regression threshold in percent (default 15)",
+    )
+    p_led.add_argument(
+        "--baseline", type=int, default=None, metavar="N",
+        help="compare against only the last N baseline rounds",
+    )
+    p_led.add_argument(
+        "--selfcheck", action="store_true",
+        help="hermetic two-round gate smoke instead of reading a ledger",
+    )
+    p_led.set_defaults(fn=_ledger)
     p_check = sub.add_parser(
         "selfcheck", help="hermetic registry->events->report smoke"
     )

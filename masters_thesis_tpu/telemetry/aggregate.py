@@ -102,6 +102,14 @@ def digest_stream(path: Path, root: Path) -> dict:
         None,
     )
     finished = (by_kind.get("run_finished") or [None])[-1]
+    last_start = starts[-1] if starts else {}
+    # The hot program's static cost model (telemetry/costs.py payload) —
+    # the training program when present, else the last profile emitted.
+    profiles = by_kind.get("cost_profile", [])
+    cost = next(
+        (e for e in profiles if str(e.get("program", "")).startswith("train")),
+        profiles[-1] if profiles else None,
+    )
     epochs = by_kind.get("epoch", [])
     epoch_walls: dict[int, float] = {}
     for e in epochs:
@@ -134,6 +142,13 @@ def digest_stream(path: Path, root: Path) -> dict:
         "finished": finished is not None,
         "diverged": bool(finished and finished.get("diverged")),
         "steps_per_sec": finished.get("steps_per_sec") if finished else None,
+        "platform": last_start.get("platform"),
+        "n_devices": last_start.get("n_devices"),
+        "cost_profile": None if cost is None else {
+            k: cost.get(k)
+            for k in ("program", "available", "flops_per_step",
+                      "bytes_per_step", "peak_bytes")
+        },
         "epochs": len(epoch_walls),
         "last_epoch": max(epoch_walls) if epoch_walls else None,
         "epoch_walls": epoch_walls,
@@ -310,6 +325,48 @@ def aggregate_streams(
                and d["status"] != "finished" for d in digests):
             failures.append(failures_note)
 
+    # Fleet utilization: the hot program's static cost × the fleet's step
+    # rate, with the comms side fed by the wait attribution above — the
+    # mean fraction of shared-epoch wall each process spent blocked in the
+    # collective. This is the ONLY place comms-bound can be diagnosed (a
+    # single stream cannot see the fleet max), so summarize splits only
+    # compute/memory and the aggregate view owns the third regime.
+    fleet_util = None
+    cost_digest = next((d for d in digests if d.get("cost_profile")), None)
+    if cost_digest is not None:
+        from masters_thesis_tpu.telemetry.costs import utilization
+
+        cost = cost_digest["cost_profile"]
+        rates = [d["steps_per_sec"] for d in digests
+                 if d.get("steps_per_sec")]
+        mean_sps = sum(rates) / len(rates) if rates else None
+        comms_frac = None
+        if shared and len(collective_wait) > 1:
+            fleet_wall = sum(max(w[e] for w in walls) for e in shared)
+            if fleet_wall > 0:
+                comms_frac = sum(collective_wait.values()) / (
+                    fleet_wall * len(collective_wait)
+                )
+        fleet_util = {
+            "program": cost.get("program"),
+            "available": bool(cost.get("available")),
+            "flops_per_step": cost.get("flops_per_step"),
+            "bytes_per_step": cost.get("bytes_per_step"),
+            "processes_profiled": sum(
+                1 for d in digests if d.get("cost_profile")
+            ),
+        }
+        fleet_util.update(
+            utilization(
+                cost.get("flops_per_step"),
+                cost.get("bytes_per_step"),
+                mean_sps,
+                cost_digest.get("platform"),
+                cost_digest.get("n_devices"),
+                comms_frac,
+            )
+        )
+
     return {
         "processes": digests,
         "expected_processes": expected,
@@ -333,6 +390,7 @@ def aggregate_streams(
             h: sum(v) / len(v) for h, v in sorted(per_host_wall.items())
         },
         "collective_wait_s": collective_wait,
+        "utilization": fleet_util,
         "straggler": straggler,
         "heartbeat_gaps_s": heartbeat_gaps,
         "failures": failures,
@@ -432,6 +490,25 @@ def render_fleet_text(report: dict, postmortem: bool = False) -> str:
             for label, wait in sorted(report["collective_wait_s"].items())
         )
         lines.append(f"collective wait: {waits}")
+    util = report.get("utilization")
+    if util is not None:
+        if util.get("available"):
+            frac = util.get("comms_wait_frac")
+            lines.append(
+                f"utilization    : {util.get('program')} | "
+                f"AI {_fmt(util.get('arithmetic_intensity'), '.3g')} | "
+                f"{_fmt(util.get('flops_utilization_pct'), '.4g')}% of peak "
+                f"FLOP/s | {util.get('regime') or 'n/a'}"
+                + (
+                    f" (comms wait {100.0 * frac:.1f}% of fleet wall)"
+                    if frac is not None
+                    else ""
+                )
+            )
+        else:
+            lines.append(
+                "utilization    : n/a (backend reported no cost model)"
+            )
     s = report["straggler"]
     if s is not None:
         lines.append(

@@ -1,0 +1,548 @@
+"""Static cost models and roofline attribution for compiled programs.
+
+The telemetry stack measures WHEN a run is slow (epoch timing, compile
+counts, collective wait); this module explains WHY the hardware is idle.
+Every executable the framework produces — the scan-epoch program, the
+stream train step, the Pallas-routed recurrences, and each AOT serve
+bucket — has a static cost model the compiler already computed:
+
+- ``Lowered.cost_analysis()`` / ``Compiled.cost_analysis()`` — FLOPs,
+  bytes accessed, transcendentals. jax 0.4.x returns a LIST of one dict
+  whose keys are space-separated strings, and backends may omit keys —
+  everything here reads defensively and degrades to a warn-once
+  ``cost_unavailable`` event instead of crashing or silently omitting.
+- ``Compiled.memory_analysis()`` — argument/output/temp/alias bytes from
+  the buffer assignment; peak ≈ argument + output + temp − alias (the
+  aliased donation bytes are counted on both sides).
+
+Static cost × the async-aware epoch timing (telemetry/run.py) gives the
+utilization story: achieved FLOP/s, achieved bytes/s, arithmetic
+intensity, and a roofline regime (compute- / memory- / comms-bound; the
+comms side is fed by the aggregator's collective-wait attribution).
+
+Import contract: NO top-level jax import. The pure pieces (roofline
+math, regime classification, CP401–CP403 rule evaluation) are consumed
+by the jax-free ``summarize``/``postmortem``/``ledger`` CLIs; only the
+extraction entry points touch jax, lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+from masters_thesis_tpu.analysis.findings import Finding
+
+# ------------------------------------------------------------- roofline
+#
+# Nominal per-device peaks used for utilization percentages and the
+# compute-vs-memory ridge point. These are ORDER-OF-MAGNITUDE anchors
+# (the CP403 floor is 1%, far below any generation-to-generation spread),
+# not a calibrated model of a specific chip: the repo runs on whatever
+# TPU the relay leases plus an 8-device virtual CPU mesh, and an honest
+# ridge matters more than a flattering MFU. Override per deployment with
+# MT_PEAK_FLOPS / MT_PEAK_BYTES_PER_S (floats, per device).
+PLATFORM_PEAKS: dict[str, dict[str, float]] = {
+    # Dense f32-equivalent MXU throughput and HBM bandwidth, TPU v4-ish.
+    "tpu": {"flops_per_sec": 137.5e12, "bytes_per_sec": 1.2e12},
+    # Data-center GPU ballpark (A100-class f32 tensor / HBM2e).
+    "gpu": {"flops_per_sec": 19.5e12, "bytes_per_sec": 1.5e12},
+    # One host core of the virtual mesh (XLA:CPU, AVX f32 FMA + DRAM).
+    "cpu": {"flops_per_sec": 5e10, "bytes_per_sec": 2e10},
+}
+
+#: Collective-wait fraction of wall time past which a program is
+#: classified comms-bound regardless of its arithmetic intensity — the
+#: chip is idle waiting on the fabric, not on FLOPs or HBM.
+COMMS_BOUND_FRAC = 0.25
+
+#: CP403: on a real TPU backend, achieved-FLOP/s utilization below this
+#: fraction of nominal peak means the program structurally cannot feed
+#: the MXU (ROADMAP: "the H=64 LSTM leaves the MXU mostly idle") — a
+#: finding, so scale-out work sees it before multiplying the waste.
+TPU_UTILIZATION_FLOOR = 0.01
+
+
+def platform_peaks(platform: str | None) -> dict[str, float] | None:
+    """Per-device nominal peaks for a platform; env-overridable."""
+    peaks = PLATFORM_PEAKS.get((platform or "").lower())
+    if peaks is None:
+        return None
+    out = dict(peaks)
+    for key, env in (
+        ("flops_per_sec", "MT_PEAK_FLOPS"),
+        ("bytes_per_sec", "MT_PEAK_BYTES_PER_S"),
+    ):
+        raw = os.environ.get(env)
+        if raw:
+            try:
+                out[key] = float(raw)
+            except ValueError:
+                pass
+    return out
+
+
+def roofline_regime(
+    intensity: float | None,
+    platform: str | None,
+    comms_frac: float | None = None,
+) -> str | None:
+    """compute-bound / memory-bound / comms-bound, or None when unknowable.
+
+    The compute/memory split compares arithmetic intensity (flops per
+    byte accessed) against the platform's ridge point; the comms verdict
+    overrides both when the aggregator attributes more than
+    COMMS_BOUND_FRAC of wall time to collective wait.
+    """
+    if comms_frac is not None and comms_frac > COMMS_BOUND_FRAC:
+        return "comms-bound"
+    peaks = platform_peaks(platform)
+    if intensity is None or peaks is None:
+        return None
+    ridge = peaks["flops_per_sec"] / peaks["bytes_per_sec"]
+    return "compute-bound" if intensity >= ridge else "memory-bound"
+
+
+def utilization(
+    flops_per_step: float | None,
+    bytes_per_step: float | None,
+    steps_per_sec: float | None,
+    platform: str | None,
+    n_devices: int | None = 1,
+    comms_frac: float | None = None,
+) -> dict:
+    """Achieved rates + roofline verdict from static cost × measured rate.
+
+    All fields are None-tolerant: a report renders "n/a" for whatever the
+    backend or the run failed to produce, never a crash.
+    """
+    achieved_flops = achieved_bytes = None
+    if steps_per_sec is not None and steps_per_sec > 0:
+        if flops_per_step is not None:
+            achieved_flops = flops_per_step * steps_per_sec
+        if bytes_per_step is not None:
+            achieved_bytes = bytes_per_step * steps_per_sec
+    intensity = None
+    if flops_per_step and bytes_per_step:
+        intensity = flops_per_step / bytes_per_step
+    peaks = platform_peaks(platform)
+    n = max(1, int(n_devices or 1))
+    flops_util = bytes_util = None
+    if peaks is not None:
+        if achieved_flops is not None:
+            flops_util = achieved_flops / (peaks["flops_per_sec"] * n)
+        if achieved_bytes is not None:
+            bytes_util = achieved_bytes / (peaks["bytes_per_sec"] * n)
+    return {
+        "achieved_flops_per_sec": achieved_flops,
+        "achieved_bytes_per_sec": achieved_bytes,
+        "arithmetic_intensity": intensity,
+        "flops_utilization_pct": (
+            None if flops_util is None else 100.0 * flops_util
+        ),
+        "bytes_utilization_pct": (
+            None if bytes_util is None else 100.0 * bytes_util
+        ),
+        "regime": roofline_regime(intensity, platform, comms_frac),
+        "comms_wait_frac": comms_frac,
+        "nominal_peaks": peaks,
+    }
+
+
+# ------------------------------------------------------ cost extraction
+
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """One program's static cost model, normalized across jax versions.
+
+    ``source`` records where the numbers came from: ``"compiled"`` (post-
+    optimization — authoritative), ``"lowered"`` (pre-optimization HLO —
+    cheap, no XLA compile), or ``"unavailable"``.
+    """
+
+    program: str
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    transcendentals: float | None = None
+    argument_bytes: int | None = None
+    output_bytes: int | None = None
+    temp_bytes: int | None = None
+    alias_bytes: int | None = None
+    generated_code_bytes: int | None = None
+    source: str = "unavailable"
+    #: Steps of training the program performs per execution (the scan
+    #: epoch runs steps_per_epoch optimizer steps in one call; the stream
+    #: step and a serve bucket run 1).
+    steps_per_execution: int = 1
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def available(self) -> bool:
+        return self.flops is not None or self.bytes_accessed is not None
+
+    @property
+    def peak_bytes(self) -> int | None:
+        """Device-memory high-water estimate from the buffer assignment.
+
+        Donated inputs alias their outputs, so alias bytes are subtracted
+        once (they would otherwise be double-counted on both sides).
+        """
+        parts = [self.argument_bytes, self.output_bytes, self.temp_bytes]
+        if all(p is None for p in parts):
+            return None
+        total = sum(p or 0 for p in parts) - (self.alias_bytes or 0)
+        return max(0, total)
+
+    @property
+    def flops_per_step(self) -> float | None:
+        if self.flops is None:
+            return None
+        return self.flops / max(1, self.steps_per_execution)
+
+    @property
+    def bytes_per_step(self) -> float | None:
+        if self.bytes_accessed is None:
+            return None
+        return self.bytes_accessed / max(1, self.steps_per_execution)
+
+    def to_payload(self) -> dict:
+        """Flat dict for a ``cost_profile`` event / bench detail block."""
+        return {
+            "program": self.program,
+            "source": self.source,
+            "available": self.available,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "transcendentals": self.transcendentals,
+            "steps_per_execution": self.steps_per_execution,
+            "flops_per_step": self.flops_per_step,
+            "bytes_per_step": self.bytes_per_step,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "peak_bytes": self.peak_bytes,
+            "meta": self.meta,
+        }
+
+
+def _scalar_costs(analysis: Any) -> dict[str, float] | None:
+    """Normalize ``cost_analysis()`` output across jax versions.
+
+    jax 0.4.x returns ``[{...}]`` with space-separated keys (plus
+    per-operand ``bytes accessed0{}`` entries we fold away); older/newer
+    versions return a bare dict. Unknown shapes -> None, never a raise.
+    """
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    out: dict[str, float] = {}
+    for key in ("flops", "transcendentals", "bytes accessed"):
+        value = analysis.get(key)
+        if isinstance(value, (int, float)) and value >= 0:
+            out[key] = float(value)
+    return out or None
+
+
+def extract_cost(
+    compiled: Any = None,
+    lowered: Any = None,
+    *,
+    program: str,
+    steps_per_execution: int = 1,
+    meta: dict | None = None,
+) -> CostModel:
+    """Build a :class:`CostModel` from AOT stage objects, defensively.
+
+    Prefers the compiled executable's post-optimization numbers; falls
+    back to the lowering's pre-optimization estimate; returns an
+    ``unavailable`` model (never raises) when the backend offers neither.
+    """
+    meta = dict(meta or {})
+    scalars = None
+    source = "unavailable"
+    for obj, label in ((compiled, "compiled"), (lowered, "lowered")):
+        if obj is None:
+            continue
+        try:
+            scalars = _scalar_costs(obj.cost_analysis())
+        except Exception:  # noqa: BLE001 — backend-dependent API surface
+            scalars = None
+        if scalars is not None:
+            source = label
+            break
+    mem: dict[str, int | None] = {}
+    if compiled is not None:
+        try:
+            stats = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001
+            stats = None
+        for field, attr in (
+            ("argument_bytes", "argument_size_in_bytes"),
+            ("output_bytes", "output_size_in_bytes"),
+            ("temp_bytes", "temp_size_in_bytes"),
+            ("alias_bytes", "alias_size_in_bytes"),
+            ("generated_code_bytes", "generated_code_size_in_bytes"),
+        ):
+            value = getattr(stats, attr, None)
+            if isinstance(value, int) and value >= 0:
+                mem[field] = value
+    scalars = scalars or {}
+    return CostModel(
+        program=program,
+        flops=scalars.get("flops"),
+        bytes_accessed=scalars.get("bytes accessed"),
+        transcendentals=scalars.get("transcendentals"),
+        source=source if scalars or mem else "unavailable",
+        steps_per_execution=steps_per_execution,
+        meta=meta,
+        **mem,
+    )
+
+
+def profile_jit(
+    fn: Any,
+    *args: Any,
+    program: str,
+    steps_per_execution: int = 1,
+    meta: dict | None = None,
+    compile: bool = True,
+    **kwargs: Any,
+) -> CostModel:
+    """Lower (and optionally AOT-compile) a jitted callable for its cost.
+
+    ``fn.lower()`` only traces — it neither executes nor consumes donated
+    buffers, and it does NOT touch the jit dispatch cache (CompileTracker
+    / TA201 counts are unaffected; verified by tests). ``compile=True``
+    additionally runs the XLA compile to get ``memory_analysis()`` — one
+    extra compile, paid only where a caller asked for the memory story.
+    """
+    lowered = fn.lower(*args, **kwargs)
+    compiled = lowered.compile() if compile else None
+    return extract_cost(
+        compiled,
+        lowered,
+        program=program,
+        steps_per_execution=steps_per_execution,
+        meta=meta,
+    )
+
+
+# ------------------------------------------------------- event emission
+
+
+def emit_cost_profile(tel: Any, cost: CostModel, **extra: Any) -> dict:
+    """Emit one ``cost_profile`` event for a program's compile.
+
+    When the backend produced no cost model at all, emit a single
+    warn-once ``cost_unavailable`` event per run instead — repeated
+    unavailable programs must not spam the stream, and ``summarize``
+    renders the utilization section as "n/a" rather than omitting it.
+    """
+    payload = {**cost.to_payload(), **extra}
+    if not cost.available and cost.peak_bytes is None:
+        warned = getattr(tel, "_cost_unavailable_warned", False)
+        if not warned:
+            tel._cost_unavailable_warned = True
+            return tel.event(
+                "cost_unavailable",
+                program=cost.program,
+                source=cost.source,
+                note="backend returned no cost_analysis/memory_analysis; "
+                "utilization reports will render n/a",
+            )
+        return {}
+    return tel.event("cost_profile", **payload)
+
+
+# --------------------------------------------------------- device budget
+
+
+def device_memory_budget(mesh: Any = None) -> int | None:
+    """Per-device memory budget in bytes, from the backend's own report.
+
+    TPU/GPU runtimes expose ``memory_stats()['bytes_limit']``; the CPU
+    host platform reports nothing (None — budget checks are skipped on
+    the virtual mesh rather than invented).
+    """
+    try:
+        import jax
+
+        devices = (
+            list(mesh.devices.flat) if mesh is not None else jax.devices()
+        )
+        stats = devices[0].memory_stats() if devices else None
+    except Exception:  # noqa: BLE001 — probing must never break a run
+        return None
+    if not isinstance(stats, dict):
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if isinstance(limit, (int, float)) and limit > 0 else None
+
+
+# ------------------------------------------------------ CP401–403 rules
+
+
+def cost_findings(
+    cost: CostModel | None,
+    *,
+    platform: str | None,
+    budget_bytes: int | None = None,
+    flops_utilization_pct: float | None = None,
+) -> list[Finding]:
+    """Evaluate the cost-observability findings rules for one program.
+
+    - **CP401** — the backend is one where cost models ARE extractable
+      (cpu/tpu/gpu XLA backends all implement cost_analysis) but
+      extraction produced nothing: the observability contract is broken.
+    - **CP402** — the compiled program's peak memory estimate exceeds the
+      backend's own reported device budget: the program is OOM-bound
+      before it runs.
+    - **CP403** — on a real TPU backend, achieved-FLOP/s utilization sits
+      below the floor: the program structurally cannot feed the chip and
+      scaling it out multiplies idle silicon.
+    """
+    findings: list[Finding] = []
+    plat = (platform or "").lower()
+    program = cost.program if cost is not None else "?"
+    if plat in ("cpu", "tpu", "gpu") and (cost is None or not cost.available):
+        findings.append(
+            Finding(
+                rule="CP401",
+                message=f"no static cost model extractable for program "
+                f"{program!r} on backend {plat!r} (cost_analysis and "
+                "memory_analysis both empty)",
+            )
+        )
+    if (
+        cost is not None
+        and budget_bytes
+        and cost.peak_bytes is not None
+        and cost.peak_bytes > budget_bytes
+    ):
+        findings.append(
+            Finding(
+                rule="CP402",
+                message=f"program {program!r} peak memory estimate "
+                f"{cost.peak_bytes} B exceeds the device budget "
+                f"{budget_bytes} B (arguments {cost.argument_bytes} + "
+                f"outputs {cost.output_bytes} + temps {cost.temp_bytes} "
+                f"- aliased {cost.alias_bytes})",
+            )
+        )
+    if (
+        plat == "tpu"
+        and flops_utilization_pct is not None
+        and flops_utilization_pct < 100.0 * TPU_UTILIZATION_FLOOR
+    ):
+        findings.append(
+            Finding(
+                rule="CP403",
+                message=f"program {program!r} achieved "
+                f"{flops_utilization_pct:.3f}% of nominal TPU FLOP/s "
+                f"(floor {100.0 * TPU_UTILIZATION_FLOOR:.1f}%) — the "
+                "program cannot feed the MXU; see docs/telemetry.md "
+                "roofline playbook before scaling it out",
+            )
+        )
+    return findings
+
+
+# ------------------------------------------- Pallas recurrence routing
+
+
+def lstm_route_cost(
+    n_t: int,
+    rows: int,
+    hidden: int,
+    n_layers: int = 2,
+    *,
+    has_mask: bool = False,
+    window_rows: int | None = None,
+    itemsize: int = 4,
+    compile: bool = True,
+) -> CostModel:
+    """Cost-profile the LSTM recurrence the router would actually run.
+
+    Builds the recurrence program at the given shape with ``impl="auto"``
+    (the same routing the trainer takes on this backend), lowers/compiles
+    it, and annotates the result with the router's plan — predicted VMEM
+    bytes from the byte model (ops/lstm_kernel.py) next to the
+    compiler-reported actual temp bytes, so the byte model is auditable
+    against the compiler instead of trusted blindly. On non-TPU backends
+    the route is the XLA scan and the prediction records what the Pallas
+    path WOULD have budgeted.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from masters_thesis_tpu.ops import lstm_kernel as lk
+
+    plan = lk.route_plan(
+        n_t,
+        rows,
+        hidden,
+        n_layers,
+        has_mask=has_mask,
+        itemsize=itemsize,
+        window_rows=window_rows,
+    )
+    dtype = jnp.float32 if itemsize == 4 else jnp.bfloat16
+    four_h = 4 * hidden
+    x_struct = jax.ShapeDtypeStruct((n_t, rows, four_h), dtype)
+    if n_layers == 1:
+        w_struct = jax.ShapeDtypeStruct((hidden, four_h), dtype)
+
+        def run(x, w):
+            return lk.lstm_recurrence(x, w, window_rows=window_rows)
+
+        args = (x_struct, w_struct)
+    else:
+        weights = (
+            tuple(
+                jax.ShapeDtypeStruct((hidden, four_h), dtype)
+                for _ in range(n_layers)
+            ),
+            tuple(
+                jax.ShapeDtypeStruct((hidden, four_h), dtype)
+                for _ in range(n_layers - 1)
+            ),
+            tuple(
+                jax.ShapeDtypeStruct((four_h,), dtype)
+                for _ in range(n_layers - 1)
+            ),
+        )
+        if has_mask:
+            masks = tuple(
+                jax.ShapeDtypeStruct((n_t, rows, hidden), dtype)
+                for _ in range(n_layers - 1)
+            )
+
+            def run(x, w, m):
+                return lk.lstm_stack_recurrence(
+                    x, w, masks=m, window_rows=window_rows
+                )
+
+            args = (x_struct, weights, masks)
+        else:
+
+            def run(x, w):
+                return lk.lstm_stack_recurrence(
+                    x, w, masks=None, window_rows=window_rows
+                )
+
+            args = (x_struct, weights)
+    cost = profile_jit(
+        jax.jit(run),
+        *args,
+        program=f"lstm_recurrence_L{n_layers}",
+        compile=compile,
+        meta=plan,
+    )
+    if cost.temp_bytes is not None and plan.get("predicted_vmem_bytes"):
+        ratio = cost.temp_bytes / plan["predicted_vmem_bytes"]
+        cost.meta["temp_vs_predicted_ratio"] = round(ratio, 4)
+    return cost

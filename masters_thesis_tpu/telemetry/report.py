@@ -22,6 +22,10 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+from masters_thesis_tpu.telemetry.costs import (
+    TPU_UTILIZATION_FLOOR,
+    utilization as _roofline_utilization,
+)
 from masters_thesis_tpu.telemetry.events import read_events
 
 EVENTS_FILENAME = "events.jsonl"
@@ -122,6 +126,12 @@ def summarize_events(events: list[dict]) -> dict:
 
     restarts = _restart_stats(events, by_kind)
     serve = _serve_stats(by_kind)
+    util = _utilization_stats(
+        by_kind,
+        steps_per_sec,
+        started.get("platform"),
+        started.get("n_devices"),
+    )
 
     preflight = (by_kind.get("preflight") or [{}])[-1]
     # Gradient-sync footprint (flat update path, train/flatparams.py): the
@@ -180,6 +190,7 @@ def summarize_events(events: list[dict]) -> dict:
         },
         "restarts": restarts,
         "serve": serve,
+        "utilization": util,
         "preflight": preflight.get("status"),
         "diverged": finished.get("diverged"),
         "profile_windows": profile_windows,
@@ -281,6 +292,58 @@ def _serve_stats(by_kind: dict) -> dict | None:
     }
 
 
+def _utilization_stats(
+    by_kind: dict,
+    steps_per_sec: float | None,
+    platform: str | None,
+    n_devices: int | None,
+) -> dict | None:
+    """Roofline section from cost_profile events; None for pre-cost runs.
+
+    Static cost (FLOPs/bytes per step from the compiler) × the measured
+    post-compile step rate gives achieved FLOP/s and the roofline regime.
+    A stream that recorded only ``cost_unavailable`` (backend reported no
+    cost model) still gets a section — rendered "n/a", never omitted.
+    The comms-bound verdict needs the aggregator's collective-wait
+    attribution, so a single-stream summarize only splits compute/memory.
+    """
+    profiles = by_kind.get("cost_profile", [])
+    unavailable = by_kind.get("cost_unavailable", [])
+    if not profiles and not unavailable:
+        return None
+    # Hot program = the training program when present (authoritative for
+    # steps/sec); otherwise the last profile seen (e.g. a serve-only run).
+    hot = next(
+        (e for e in profiles if str(e.get("program", "")).startswith("train")),
+        profiles[-1] if profiles else None,
+    )
+    serve_buckets = {
+        e.get("program"): e
+        for e in profiles
+        if str(e.get("program", "")).startswith("serve_bucket")
+    }
+    section = {
+        "program": hot.get("program") if hot else None,
+        "available": bool(hot and hot.get("available")),
+        "source": hot.get("source") if hot else None,
+        "flops_per_step": hot.get("flops_per_step") if hot else None,
+        "bytes_per_step": hot.get("bytes_per_step") if hot else None,
+        "peak_bytes": hot.get("peak_bytes") if hot else None,
+        "serve_buckets": len(serve_buckets),
+        "cost_unavailable_events": len(unavailable),
+    }
+    section.update(
+        _roofline_utilization(
+            section["flops_per_step"],
+            section["bytes_per_step"],
+            steps_per_sec,
+            platform,
+            n_devices,
+        )
+    )
+    return section
+
+
 def contract_violations(report: dict) -> list[str]:
     """The runtime contracts a run report is gated on (CLI exits 2)."""
     violations = []
@@ -301,6 +364,16 @@ def contract_violations(report: dict) -> list[str]:
             "their deadline (contract: late answers are rejected, never "
             "delivered)"
         )
+    util = report.get("utilization")
+    if util and (report.get("platform") or "").lower() == "tpu":
+        pct = util.get("flops_utilization_pct")
+        floor_pct = 100.0 * TPU_UTILIZATION_FLOOR
+        if pct is not None and pct < floor_pct:
+            violations.append(
+                f"utilization: {util.get('program')} achieved {pct:.3f}% of "
+                f"nominal TPU FLOP/s (floor {floor_pct:.1f}% — CP403); the "
+                "program cannot feed the MXU, see docs/telemetry.md"
+            )
     return violations
 
 
@@ -374,6 +447,27 @@ def render_text(report: dict) -> str:
             f"{sv.get('swaps_rejected', 0)}-, "
             f"{sv.get('degradations', 0)} degradation(s)",
         )
+    util = report.get("utilization")
+    if util is not None:
+        if util.get("available"):
+            line = (
+                f"utilization    : {util.get('program')} | "
+                f"flops/step {_fmt(util.get('flops_per_step'))} | "
+                f"bytes/step {_fmt(util.get('bytes_per_step'))} | "
+                f"AI {_fmt(util.get('arithmetic_intensity'), '.3g')} | "
+                f"{_fmt(util.get('flops_utilization_pct'), '.4g')}% of "
+                f"{report.get('platform') or '?'} peak FLOP/s | "
+                f"{util.get('regime') or 'n/a'}"
+            )
+        else:
+            line = (
+                "utilization    : n/a (backend reported no cost model; "
+                f"{util.get('cost_unavailable_events', 0)} "
+                "cost_unavailable event(s))"
+            )
+        if util.get("serve_buckets"):
+            line += f" | {util['serve_buckets']} serve bucket(s) profiled"
+        lines.insert(len(lines) - 1, line)
     gs = report.get("grad_sync") or {}
     if gs.get("collectives_per_step") is not None:
         lines.insert(

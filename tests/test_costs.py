@@ -1,0 +1,472 @@
+"""Cost & utilization observability tests (telemetry/costs.py, ledger.py).
+
+The e2e contract (ISSUE acceptance): a tiny run on the hermetic 8-device
+virtual CPU mesh must emit ``cost_profile`` events whose FLOPs scale
+linearly with the work (batch rows, packed windows); the perf ledger must
+round-trip append/read and its regression gate must exit 2 on a doctored
+slow round; and the summarize/ledger CLIs must render the utilization
+section without importing jax (proved under a poisoned import).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.ops.lstm_kernel import route_plan
+from masters_thesis_tpu.telemetry import TelemetryRun, read_events
+from masters_thesis_tpu.telemetry import costs
+from masters_thesis_tpu.telemetry import ledger as led
+from masters_thesis_tpu.telemetry.__main__ import main as cli_main
+from masters_thesis_tpu.telemetry.report import render_text, summarize_path
+from masters_thesis_tpu.train import Trainer
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- pure roofline
+
+
+class TestRoofline:
+    def test_utilization_numbers(self):
+        # 2e10 flops/step at 1 step/s on the cpu peaks (5e10 / 2e10).
+        u = costs.utilization(2e10, 2e10, 1.0, "cpu")
+        assert u["achieved_flops_per_sec"] == pytest.approx(2e10)
+        assert u["flops_utilization_pct"] == pytest.approx(40.0)
+        assert u["bytes_utilization_pct"] == pytest.approx(100.0)
+        assert u["arithmetic_intensity"] == pytest.approx(1.0)
+        # ridge = 5e10/2e10 = 2.5 flops/byte; intensity 1.0 sits below it.
+        assert u["regime"] == "memory-bound"
+
+    def test_compute_bound_above_ridge(self):
+        u = costs.utilization(1e12, 1e9, 1.0, "cpu")
+        assert u["regime"] == "compute-bound"
+
+    def test_comms_bound_overrides_intensity(self):
+        assert (
+            costs.roofline_regime(1000.0, "cpu", comms_frac=0.5)
+            == "comms-bound"
+        )
+        assert (
+            costs.roofline_regime(1000.0, "cpu", comms_frac=0.1)
+            == "compute-bound"
+        )
+
+    def test_none_tolerance(self):
+        u = costs.utilization(None, None, None, "not-a-platform")
+        assert u["achieved_flops_per_sec"] is None
+        assert u["flops_utilization_pct"] is None
+        assert u["regime"] is None
+
+    def test_peak_env_override(self, monkeypatch):
+        monkeypatch.setenv("MT_PEAK_FLOPS", "1e10")
+        u = costs.utilization(1e10, 1e10, 1.0, "cpu")
+        assert u["flops_utilization_pct"] == pytest.approx(100.0)
+
+    def test_n_devices_scales_the_denominator(self):
+        one = costs.utilization(2e10, 2e10, 1.0, "cpu", n_devices=1)
+        eight = costs.utilization(2e10, 2e10, 1.0, "cpu", n_devices=8)
+        assert one["flops_utilization_pct"] == pytest.approx(
+            8 * eight["flops_utilization_pct"]
+        )
+
+
+# ------------------------------------------------------------- extraction
+
+
+class TestExtraction:
+    def test_profile_jit_compiled_source(self):
+        w = jnp.ones((16, 16), jnp.float32)
+
+        @jax.jit
+        def f(x):
+            return jnp.tanh(x @ w).sum()
+
+        cost = costs.profile_jit(
+            f, jnp.ones((8, 16), jnp.float32), program="unit_matmul"
+        )
+        assert cost.available and cost.source == "compiled"
+        assert cost.flops and cost.flops > 0
+        assert cost.peak_bytes and cost.peak_bytes > 0
+        # The payload must be a JSON-serializable flat dict (event body).
+        payload = json.loads(json.dumps(cost.to_payload()))
+        assert payload["program"] == "unit_matmul"
+        assert payload["flops_per_step"] == pytest.approx(cost.flops)
+
+    def test_flops_linear_in_batch_rows(self):
+        base = costs.lstm_route_cost(4, 8, 8, 1, compile=False)
+        doubled = costs.lstm_route_cost(4, 16, 8, 1, compile=False)
+        assert base.available and doubled.available
+        assert doubled.flops / base.flops == pytest.approx(2.0, rel=0.15)
+
+    def test_flops_linear_in_packed_windows(self):
+        # rows = pack * window_rows: each extra packed window adds the
+        # same recurrence work, so FLOPs scale linearly in the pack count.
+        one = costs.lstm_route_cost(4, 8, 8, 1, window_rows=8, compile=False)
+        two = costs.lstm_route_cost(4, 16, 8, 1, window_rows=8, compile=False)
+        four = costs.lstm_route_cost(4, 32, 8, 1, window_rows=8, compile=False)
+        assert two.flops / one.flops == pytest.approx(2.0, rel=0.15)
+        assert four.flops / one.flops == pytest.approx(4.0, rel=0.15)
+        # The router's plan rides along in meta for the telemetry stream.
+        assert one.meta["route"]
+        assert one.meta["predicted_vmem_bytes"] > 0
+
+    def test_route_plan_mirrors_tpu_packing(self):
+        # The canonical 25-stock shape packs 2 windows/program on TPU
+        # (RESULTS.md round-6); the plan must report the same decision the
+        # dispatch predicates would take, without needing a TPU.
+        plan = route_plan(60, 4160, 64, 1, window_rows=52, backend="tpu")
+        assert plan["route"] == "pallas-packed"
+        assert plan["pack_width"] == 2
+        cpu = route_plan(60, 4160, 64, 1, window_rows=52, backend="cpu")
+        assert cpu["route"] == "xla-scan"
+
+    def test_extract_cost_never_raises(self):
+        class Broken:
+            def cost_analysis(self):
+                raise RuntimeError("backend says no")
+
+            def memory_analysis(self):
+                raise RuntimeError("backend says no")
+
+        cost = costs.extract_cost(Broken(), Broken(), program="broken")
+        assert not cost.available and cost.source == "unavailable"
+        assert cost.peak_bytes is None
+
+    def test_emit_warn_once_when_unavailable(self):
+        class FakeTel:
+            def __init__(self):
+                self.events = []
+
+            def event(self, kind, **payload):
+                self.events.append({"kind": kind, **payload})
+                return self.events[-1]
+
+        tel = FakeTel()
+        dead = costs.CostModel(program="dead")
+        costs.emit_cost_profile(tel, dead)
+        costs.emit_cost_profile(tel, dead)
+        kinds = [e["kind"] for e in tel.events]
+        assert kinds == ["cost_unavailable"]  # once, not per program
+        live = costs.CostModel(program="live", flops=1.0, bytes_accessed=2.0)
+        costs.emit_cost_profile(tel, live)
+        assert tel.events[-1]["kind"] == "cost_profile"
+        assert tel.events[-1]["program"] == "live"
+
+
+# -------------------------------------------------------- CP401-403 rules
+
+
+class TestCostFindings:
+    def test_cp401_unavailable_on_xla_backend(self):
+        out = costs.cost_findings(
+            costs.CostModel(program="p"), platform="cpu"
+        )
+        assert [f.rule for f in out] == ["CP401"]
+
+    def test_cp402_over_budget(self):
+        cost = costs.CostModel(
+            program="p", flops=1.0, bytes_accessed=1.0,
+            argument_bytes=600, output_bytes=300, temp_bytes=200,
+        )
+        out = costs.cost_findings(cost, platform="cpu", budget_bytes=1000)
+        assert [f.rule for f in out] == ["CP402"]
+        assert costs.cost_findings(
+            cost, platform="cpu", budget_bytes=2000
+        ) == []
+
+    def test_cp403_tpu_floor_only(self):
+        cost = costs.CostModel(program="p", flops=1.0, bytes_accessed=1.0)
+        low = costs.cost_findings(
+            cost, platform="tpu", flops_utilization_pct=0.5
+        )
+        assert [f.rule for f in low] == ["CP403"]
+        # The virtual CPU mesh can't feed a TPU roofline — no CP403 there.
+        assert costs.cost_findings(
+            cost, platform="cpu", flops_utilization_pct=0.5
+        ) == []
+
+    def test_alias_bytes_subtracted_once(self):
+        cost = costs.CostModel(
+            program="p", argument_bytes=100, output_bytes=100,
+            temp_bytes=50, alias_bytes=100,
+        )
+        assert cost.peak_bytes == 150
+
+
+# ----------------------------------------------- trainer + serve wiring
+
+
+@pytest.fixture(scope="module")
+def tiny_dm(tmp_path_factory) -> FinancialWindowDataModule:
+    data_dir = tmp_path_factory.mktemp("cost_data")
+    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+        n_stocks=8, n_samples=4000, seed=1
+    )
+    np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+    np.save(data_dir / "market.npy", np.asarray(r_market))
+    np.save(data_dir / "alphas.npy", np.asarray(alphas))
+    np.save(data_dir / "betas.npy", np.asarray(betas))
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24, batch_size=2
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    return dm
+
+
+def _small_spec():
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        learning_rate=1e-2,
+    )
+
+
+@pytest.fixture(scope="module")
+def cost_run(tiny_dm, tmp_path_factory):
+    """One telemetry-on 2-epoch scan run; (run_dir, TrainResult)."""
+    run_dir = tmp_path_factory.mktemp("cost_run")
+    tel = TelemetryRun(run_dir)
+    trainer = Trainer(
+        max_epochs=2,
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=1,
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+        strategy="tpu_xla",
+        telemetry=tel,
+    )
+    result = trainer.fit(_small_spec(), tiny_dm)
+    tel.close()
+    return run_dir, result
+
+
+class TestTrainerCostProfile:
+    def test_cost_profile_event_emitted(self, cost_run):
+        run_dir, result = cost_run
+        events = read_events(run_dir / "events.jsonl")
+        profiles = [e for e in events if e["kind"] == "cost_profile"]
+        assert len(profiles) == 1
+        p = profiles[0]
+        assert p["program"] == "train_epoch_scan"
+        assert p["available"] and p["flops"] > 0
+        # The scan program amortizes steps_per_epoch optimizer steps.
+        assert p["steps_per_execution"] > 1
+        assert p["flops_per_step"] == pytest.approx(
+            p["flops"] / p["steps_per_execution"]
+        )
+        # The routing decision rides along: plan + predicted VMEM bytes.
+        route = p["meta"]["lstm_route"]
+        assert route["route"] == "xla-scan"  # CPU backend
+        assert route["predicted_vmem_bytes"] > 0
+
+    def test_train_result_carries_payload(self, cost_run):
+        _, result = cost_run
+        assert result.cost_profile is not None
+        assert result.cost_profile["available"]
+        assert result.cost_profile["peak_bytes"] > 0
+
+    def test_summarize_reports_utilization(self, cost_run):
+        run_dir, _ = cost_run
+        report = summarize_path(run_dir)
+        util = report["utilization"]
+        assert util["available"]
+        assert util["program"] == "train_epoch_scan"
+        assert util["flops_per_step"] > 0
+        assert util["regime"] in ("compute-bound", "memory-bound")
+        assert util["flops_utilization_pct"] > 0
+        text = render_text(report)
+        assert "utilization" in text and "flops/step" in text
+
+    def test_summarize_cli_is_jax_free(self, cost_run, tmp_path):
+        # The utilization section must render on a machine where importing
+        # jax would hang (wedged relay): poison the import and run the CLI
+        # in a fresh interpreter against the real run's events.
+        run_dir, _ = cost_run
+        poison = tmp_path / "poison"
+        poison.mkdir()
+        (poison / "jax.py").write_text(
+            "raise ImportError('summarize CLI imported jax')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+             "summarize", str(run_dir)],
+            cwd=_REPO_ROOT,
+            env={**os.environ, "PYTHONPATH": f"{poison}:{_REPO_ROOT}"},
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "utilization" in out.stdout
+        assert "flops/step" in out.stdout
+
+    def test_stream_mode_profiles_the_step(self, tiny_dm):
+        trainer = Trainer(
+            max_epochs=1,
+            gradient_clip_val=5.0,
+            check_val_every_n_epoch=1,
+            enable_progress_bar=False,
+            enable_model_summary=False,
+            seed=0,
+            strategy="tpu_xla",
+            epoch_mode="stream",
+            cost_profile=True,
+        )
+        result = trainer.fit(_small_spec(), tiny_dm)
+        assert result.cost_profile is not None
+        assert result.cost_profile["program"] == "train_step_stream"
+        assert result.cost_profile["steps_per_execution"] == 1
+        assert result.cost_profile["flops"] > 0
+
+    def test_unavailable_renders_na_not_omitted(self, tmp_path):
+        tel = TelemetryRun(tmp_path)
+        tel.event("run_started", platform="cpu", n_devices=1)
+        tel.event("cost_unavailable", program="train_epoch_scan")
+        tel.event("run_finished", status="ok")
+        tel.close()
+        report = summarize_path(tmp_path)
+        util = report["utilization"]
+        assert util is not None and not util["available"]
+        text = render_text(report)
+        assert "n/a" in text and "cost_unavailable" in text
+
+
+class TestServeCost:
+    def test_buckets_profiled_and_preflight_clean(self):
+        from masters_thesis_tpu.serve.engine import PredictEngine
+        from masters_thesis_tpu.serve.preflight import run_serve_preflight
+
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            kernel_impl="xla",
+        )
+        module = spec.build_module()
+        dummy = jnp.zeros((1, 8, 3), jnp.float32)
+        params = module.init(jax.random.key(0), dummy)["params"]
+        engine = PredictEngine(
+            spec, params, n_stocks=4, lookback=8, n_features=3,
+            buckets=(1, 2),
+        )
+        engine.warmup()
+        for b in engine.buckets:
+            payload = engine.cost_profiles[b]
+            assert payload["program"] == f"serve_bucket_{b}"
+            assert payload["available"]
+            assert payload["peak_bytes"] > 0
+        # Bigger bucket moves at least as many bytes per execution.
+        assert (
+            engine.cost_profiles[2]["bytes_accessed"]
+            >= engine.cost_profiles[1]["bytes_accessed"]
+        )
+        # SV304 is budget-gated: the CPU mesh reports no budget, so the
+        # preflight stays clean rather than inventing a limit.
+        assert run_serve_preflight(buckets=(1, 2), requests=4) == []
+
+
+# ---------------------------------------------------------------- ledger
+
+
+def _row(round_id, sps, util, ts, **over):
+    base = dict(
+        point="mse/bs=1", round_id=round_id, platform="cpu",
+        steps_per_sec=sps, objective="mse", batch_size=1,
+        mesh_shape=[8], pack_width=1, flops_per_step=1.6e5,
+        bytes_per_step=7.2e5, peak_memory_bytes=3_000_000,
+        utilization_pct=util, regime="memory-bound", rev="deadbee", ts=ts,
+    )
+    base.update(over)
+    return led.ledger_record(**base)
+
+
+class TestLedger:
+    def test_append_read_round_trip(self, tmp_path):
+        path = tmp_path / "perf_ledger.jsonl"
+        r1 = _row("r1", 100.0, 4.0, 1.0)
+        r2 = _row("r2", 101.0, 4.1, 2.0)
+        led.append_record(path, r1)
+        led.append_record(path, r2)
+        rows = led.read_ledger(path)
+        assert [r["round"] for r in rows] == ["r1", "r2"]
+        assert rows[0]["schema"] == led.LEDGER_SCHEMA_VERSION
+        assert rows[0]["steps_per_sec"] == 100.0
+        # Torn tail (killed writer): the partial line is skipped, not fatal.
+        with open(path, "a") as fh:
+            fh.write('{"schema": 1, "point": "mse/bs=1", "trunc')
+        assert len(led.read_ledger(path)) == 2
+
+    def test_equal_rounds_not_regressed(self, tmp_path):
+        path = tmp_path / "perf_ledger.jsonl"
+        led.append_record(path, _row("r1", 100.0, 4.0, 1.0))
+        led.append_record(path, _row("r2", 98.0, 3.9, 2.0))
+        report = led.diff_path(path)
+        assert not report["regressed"]
+        assert report["compared"]
+        assert cli_main(["ledger", str(path)]) == 0
+
+    def test_doctored_slow_round_exits_2(self, tmp_path):
+        path = tmp_path / "perf_ledger.jsonl"
+        led.append_record(path, _row("r1", 100.0, 4.0, 1.0))
+        led.append_record(path, _row("r2", 98.0, 3.9, 2.0))
+        # Doctored: latest round runs 40% slower at the SAME config.
+        led.append_record(path, _row("r3", 60.0, 2.4, 3.0))
+        report = led.diff_path(path)
+        assert report["regressed"]
+        (reg,) = report["regressions"]
+        assert set(reg["regressed_metrics"]) == {
+            "steps_per_sec", "utilization_pct",
+        }
+        assert cli_main(["ledger", str(path)]) == 2
+        # A looser threshold lets the same round pass.
+        assert cli_main(["ledger", str(path), "--threshold", "50"]) == 0
+
+    def test_config_drift_is_not_a_regression(self, tmp_path):
+        path = tmp_path / "perf_ledger.jsonl"
+        led.append_record(path, _row("r1", 100.0, 4.0, 1.0))
+        # Same point name, different batch size: a NEW config — comparing
+        # its 60 steps/s against the bs=1 baseline would be a lie.
+        led.append_record(
+            path, _row("r2", 60.0, 2.4, 2.0, batch_size=64)
+        )
+        report = led.diff_path(path)
+        assert not report["regressed"]
+        assert report["new_configs"]
+
+    def test_missing_ledger_is_rc_1(self, tmp_path):
+        assert cli_main(["ledger", str(tmp_path / "nope.jsonl")]) == 1
+
+    def test_ledger_cli_is_jax_free(self, tmp_path):
+        path = tmp_path / "perf_ledger.jsonl"
+        led.append_record(path, _row("r1", 100.0, 4.0, 1.0))
+        led.append_record(path, _row("r2", 50.0, 2.0, 2.0))
+        poison = tmp_path / "poison"
+        poison.mkdir()
+        (poison / "jax.py").write_text(
+            "raise ImportError('ledger CLI imported jax')\n"
+        )
+        env = {**os.environ, "PYTHONPATH": f"{poison}:{_REPO_ROOT}"}
+        out = subprocess.run(
+            [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+             "ledger", str(path)],
+            cwd=_REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=60,
+        )
+        assert out.returncode == 2, out.stdout + out.stderr
+        assert "regress" in out.stdout.lower()
+        # And --selfcheck, the check.sh gate, under the same poison.
+        out = subprocess.run(
+            [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+             "ledger", "--selfcheck"],
+            cwd=_REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr

@@ -11,7 +11,7 @@ fail=0
 #    agree on the rules).
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff =="
-    ruff check masters_thesis_tpu tests || fail=1
+    ruff check masters_thesis_tpu tests bench.py train.py || fail=1
 else
     echo "== ruff == (not installed; skipping)"
 fi
@@ -30,6 +30,8 @@ echo "== telemetry selfcheck =="
 python -m masters_thesis_tpu.telemetry selfcheck || fail=1
 echo "== telemetry postmortem selfcheck =="
 python -m masters_thesis_tpu.telemetry postmortem --selfcheck || fail=1
+echo "== telemetry ledger selfcheck =="
+python -m masters_thesis_tpu.telemetry ledger --selfcheck || fail=1
 
 # 3b. resilience: supervisor end-to-end against jax-free workers
 #     (preempt -> resume, deterministic crash -> halt, NaN -> rollback)

@@ -179,6 +179,7 @@ def run(cfg: Config) -> float:
         resume=t.get("resume", False),
         preflight=t.get("preflight", False),
         telemetry=telemetry,
+        cost_profile=t.get("cost_profile", None),
         hang_timeout_s=t.get("hang_timeout_s", None),
         checkpoint_every_n_epochs=cfg.get("resilience", {}).get(
             "checkpoint_every_n_epochs", None
