@@ -69,6 +69,14 @@ def main(argv: list[str] | None = None) -> int:
         metavar="N",
         help="virtual CPU devices for the audit mesh",
     )
+    parser.add_argument(
+        "--stacked-replicas",
+        type=int,
+        default=3,
+        metavar="R",
+        help="replica count for the stacked-program audit (TA207); "
+        "0 skips it",
+    )
     args = parser.parse_args(argv)
 
     import masters_thesis_tpu
@@ -85,7 +93,12 @@ def main(argv: list[str] | None = None) -> int:
         _force_cpu_mesh(args.trace_devices)
         from masters_thesis_tpu.analysis.traceaudit import run_trace_audit
 
-        findings.extend(run_trace_audit(steps=args.trace_steps))
+        findings.extend(
+            run_trace_audit(
+                steps=args.trace_steps,
+                stacked_replicas=args.stacked_replicas or None,
+            )
+        )
 
     from masters_thesis_tpu.analysis.findings import format_report
 

@@ -26,6 +26,13 @@ and asserts the invariants the framework's performance contract rests on:
   train/flatparams.py). A second in-loop collective means the flat update
   path regressed to per-leaf reductions — the r4 sharding-overhead bug
   class (8-device slower than 1 at equal total work, RESULTS.md).
+- **TA207** — the STACKED epoch program (R replicas as a vmap axis,
+  train/steps.py:make_stacked_train_epoch) compiles exactly once across
+  varied-input epochs AND still lowers to exactly one all-reduce per
+  dtype buffer per step: ``lax.pmean`` under ``vmap`` must batch into a
+  single collective over the ``[R, n]`` buffer. R per-replica collectives
+  (or a recompile per replica-count/lr change) would erase the entire
+  cells/hour win the stacked path exists for.
 
 Everything is sized to run in seconds on CPU (``JAX_PLATFORMS=cpu`` with
 the 8-device virtual mesh) — the same invariants transfer to TPU because
@@ -51,28 +58,30 @@ AUDIT_STEPS = 3
 
 
 def count_step_collectives(compiled_hlo: str) -> int:
-    """Count cross-replica reductions in the per-step hot path (TA206).
+    """Count cross-replica reductions in the per-step hot path (TA206/TA207).
 
     Counts compiled-HLO ``all-reduce`` ops whose ``op_name`` metadata
-    places them inside the scan's while-loop body (``.../while/body/...``).
-    The epoch program legitimately owns other collectives — the metric
-    ``psum`` (once per epoch, after the scan) and the shuffle permutation's
-    sort machinery (epoch setup) — but those run per EPOCH; only while-body
-    ops pay per step. Shared with telemetry/bench so "collectives per step"
-    means the same thing everywhere.
+    places them inside the scan's while-loop body (``.../while/body/...``,
+    or ``.../vmap(while)/body/...`` when the scan runs under the stacked
+    path's replica vmap). The epoch program legitimately owns other
+    collectives — the metric ``psum`` (once per epoch, after the scan) and
+    the shuffle permutation's sort machinery (epoch setup) — but those run
+    per EPOCH; only while-body ops pay per step. Shared with telemetry/bench
+    so "collectives per step" means the same thing everywhere.
     """
     n = 0
     for line in compiled_hlo.splitlines():
         if _ALL_REDUCE_RE.search(line) is None:
             continue
         op_name = _OP_NAME_RE.search(line)
-        if op_name is not None and "while/body" in op_name.group(1):
+        if op_name is not None and _STEP_BODY_RE.search(op_name.group(1)):
             n += 1
     return n
 
 
 _ALL_REDUCE_RE = re.compile(r"= \S+ all-reduce(?:-start)?\(")
 _OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_STEP_BODY_RE = re.compile(r"(?:vmap\()?while\)?/body")
 
 
 class PreflightError(RuntimeError):
@@ -115,14 +124,17 @@ def run_trace_audit(
     mesh=None,
     steps: int = AUDIT_STEPS,
     check_collectives: bool = True,
+    stacked_replicas: int | None = None,
 ) -> list[Finding]:
     """Build + run the real epoch program on synthetic data; return findings.
 
     ``spec`` (ModelSpec) and ``mesh`` default to a tiny MSE model over all
-    visible devices. Returns an empty list when every invariant holds.
+    visible devices. With ``stacked_replicas`` set, the stacked epoch
+    program is audited too (TA207). Returns an empty list when every
+    invariant holds.
     """
     try:
-        return _run_trace_audit(spec, mesh, steps, check_collectives)
+        findings = _run_trace_audit(spec, mesh, steps, check_collectives)
     except Exception as exc:  # noqa: BLE001 — TA205 carries the cause
         return [
             Finding(
@@ -130,6 +142,151 @@ def run_trace_audit(
                 message=f"audit could not run: {type(exc).__name__}: {exc}",
             )
         ]
+    if stacked_replicas is not None:
+        findings.extend(
+            run_stacked_trace_audit(
+                spec=spec, mesh=mesh, replicas=stacked_replicas, steps=steps
+            )
+        )
+    return findings
+
+
+def run_stacked_trace_audit(
+    spec=None,
+    mesh=None,
+    replicas: int = 3,
+    steps: int = AUDIT_STEPS,
+) -> list[Finding]:
+    """TA207: audit the stacked (vmapped-replica) epoch program.
+
+    Builds the real ``make_stacked_train_epoch`` program with ``replicas``
+    heterogeneous (lr, seed) replicas and asserts the two invariants the
+    stacked throughput win rests on: the program compiles exactly once
+    across varied-input epochs, and its scan body carries exactly one
+    all-reduce per dtype buffer — the batched ``[R, n]`` gradient pmean —
+    independent of R.
+    """
+    try:
+        return _run_stacked_trace_audit(spec, mesh, replicas, steps)
+    except Exception as exc:  # noqa: BLE001 — TA205 carries the cause
+        return [
+            Finding(
+                rule="TA205",
+                message=f"stacked audit could not run: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+
+
+def _run_stacked_trace_audit(spec, mesh, replicas, steps) -> list[Finding]:
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.parallel import (
+        batch_sharding,
+        global_put,
+        make_data_mesh,
+        replicated_sharding,
+    )
+    from masters_thesis_tpu.train.flatparams import (
+        FlatAdam,
+        flatten,
+        flatten_spec,
+        num_buffers,
+        stack_flat,
+        stack_opt_states,
+    )
+    from masters_thesis_tpu.train.steps import (
+        jit_cache_size,
+        make_stacked_train_epoch,
+    )
+
+    findings: list[Finding] = []
+    if spec is None:
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            kernel_impl="xla",
+        )
+    if mesh is None:
+        mesh = make_data_mesh(None)
+
+    module = spec.build_module()
+    tx = FlatAdam(None, spec.weight_decay)
+    split = _synthetic_split(
+        mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0)
+    )
+
+    dummy = jnp.zeros((1, AUDIT_LOOKBACK, AUDIT_FEATURES), jnp.float32)
+
+    def init(seed):
+        return module.init(jax.random.key(seed), dummy)["params"]
+
+    params0 = init(0)
+    fspec = flatten_spec(params0)
+    repl = replicated_sharding(mesh)
+    pstack = global_put(
+        stack_flat([flatten(init(s), fspec) for s in range(replicas)]), repl
+    )
+    ostack = global_put(
+        stack_opt_states([tx.init(params0) for _ in range(replicas)]), repl
+    )
+    # Heterogeneous per-replica lrs: the point of the stack is differing
+    # hyperparameters riding one program.
+    lrs = global_put(
+        jnp.asarray([1e-3 * (2.0**r) for r in range(replicas)], jnp.float32),
+        repl,
+    )
+    data = global_put(split, batch_sharding(mesh))
+    epoch_rngs = [
+        global_put(
+            jnp.stack(
+                [
+                    jax.random.fold_in(jax.random.key(10 + r), e)
+                    for r in range(replicas)
+                ]
+            ),
+            repl,
+        )
+        for e in range(steps)
+    ]
+
+    epoch_fn = make_stacked_train_epoch(
+        module, spec.window_objective(), spec.metric_keys, tx, mesh, fspec,
+        batch_size=AUDIT_BATCH,
+    )
+
+    # ------------------------------------------------ TA207 (collectives)
+    lowered = epoch_fn.lower(pstack, ostack, lrs, epoch_rngs[0], data)
+    n_reduce = count_step_collectives(lowered.compile().as_text())
+    expected = num_buffers(fspec)
+    if n_reduce != expected:
+        findings.append(
+            Finding(
+                rule="TA207",
+                message=f"stacked epoch program (R={replicas}) contains "
+                f"{n_reduce} cross-replica reductions in the scan body "
+                f"(expected exactly {expected}: one batched [R, n] pmean "
+                "per dtype buffer) — the replica vmap is splitting or "
+                "duplicating the gradient collective",
+            )
+        )
+
+    # --------------------------------------------------- TA207 (compiles)
+    out = None
+    for e in range(steps):
+        out = epoch_fn(pstack, ostack, lrs, epoch_rngs[e], data)
+        pstack, ostack, _ = out
+    jax.block_until_ready(out)
+    cache_size = jit_cache_size(epoch_fn)
+    if cache_size is not None and cache_size != 1:
+        findings.append(
+            Finding(
+                rule="TA207",
+                message=f"stacked epoch program (R={replicas}) compiled "
+                f"{cache_size} times across {steps} varied-input epochs "
+                "(expected exactly 1) — the stacked jit signature is not "
+                "stable",
+            )
+        )
+    return findings
 
 
 def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:

@@ -65,6 +65,8 @@ POINTS = frozenset(
         "trainer.epoch_start",  # top of the epoch loop, before dispatch
         "trainer.epoch_dispatched",  # after dispatch, before readback/save
         "trainer.loss",  # host-side metric readback (kind: nan)
+        "stacked.replica_loss",  # per-replica readback in the stacked
+        # trainer (kind: nan; match on {"replica": r} to poison one replica)
         "data.epoch",  # host data plane, once per epoch stream
         "checkpoint.pre_publish",  # staged pair complete, not yet live
         "checkpoint.post_publish",  # after publish (kind: corrupt)

@@ -167,8 +167,9 @@ def ledger_diff(
     MEDIAN over all earlier rounds' rows with the same config key (or the
     last ``baseline_rounds`` of them). A config with no baseline is
     reported as new, never as a regression. Exit semantics live in
-    ``report["regressed"]`` — True when any compared metric (steps/s or
-    utilization) dropped more than ``threshold_pct``.
+    ``report["regressed"]`` — True when any compared metric (steps/s,
+    utilization, or — for stacked points — cells/hour) dropped more than
+    ``threshold_pct``.
     """
     order = _round_order(rows)
     if not order:
@@ -207,7 +208,7 @@ def ledger_diff(
             "baseline_rounds": len({b.get("round") for b in baseline}),
         }
         regressed_metrics: list[str] = []
-        for metric in ("steps_per_sec", "utilization_pct"):
+        for metric in ("steps_per_sec", "utilization_pct", "cells_per_hour"):
             latest_v = rec.get(metric)
             base_v = _median([b.get(metric) for b in baseline])
             row[metric] = {"latest": latest_v, "baseline": base_v}
@@ -269,14 +270,22 @@ def render_ledger_text(report: dict) -> str:
         sps = row["steps_per_sec"]
         util = row["utilization_pct"]
         mark = " <-- REGRESSED" if row["regressed_metrics"] else ""
-        lines.append(
+        line = (
             f"  {row['point']:<16s} [{row.get('platform') or '?'}] "
             f"sps {_fmt(sps['latest'], '.2f')} vs {_fmt(sps['baseline'], '.2f')}"
             f" ({_fmt(sps.get('delta_pct'), '+.1f')}%) | "
             f"util {_fmt(util['latest'], '.3f')}% vs "
             f"{_fmt(util['baseline'], '.3f')}%"
-            f" ({_fmt(util.get('delta_pct'), '+.1f')}%)" + mark
+            f" ({_fmt(util.get('delta_pct'), '+.1f')}%)"
         )
+        cph = row.get("cells_per_hour") or {}
+        if cph.get("latest") is not None:
+            line += (
+                f" | cells/h {_fmt(cph['latest'], '.1f')} vs "
+                f"{_fmt(cph['baseline'], '.1f')}"
+                f" ({_fmt(cph.get('delta_pct'), '+.1f')}%)"
+            )
+        lines.append(line + mark)
     for row in report["new_configs"]:
         lines.append(f"  {row['point']:<16s} new config (no baseline)")
     if report["regressed"]:

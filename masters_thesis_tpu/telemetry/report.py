@@ -126,6 +126,7 @@ def summarize_events(events: list[dict]) -> dict:
 
     restarts = _restart_stats(events, by_kind)
     serve = _serve_stats(by_kind)
+    replicas = _replica_stats(by_kind)
     util = _utilization_stats(
         by_kind,
         steps_per_sec,
@@ -190,6 +191,7 @@ def summarize_events(events: list[dict]) -> dict:
         },
         "restarts": restarts,
         "serve": serve,
+        "replicas": replicas,
         "utilization": util,
         "preflight": preflight.get("status"),
         "diverged": finished.get("diverged"),
@@ -289,6 +291,62 @@ def _serve_stats(by_kind: dict) -> dict | None:
         "swaps_rejected": swaps_rejected,
         "degradations": len(by_kind.get("degradation", [])),
         "clean_stop": bool(finished),
+    }
+
+
+def _replica_stats(by_kind: dict) -> dict | None:
+    """Per-replica accounting for stacked runs; None for solo runs.
+
+    Folds the stacked trainer's per-replica sub-streams: ``replica_epoch``
+    (one per replica per epoch: loss, lr, status), ``replica_status``
+    (transition events: active -> recovering -> masked, with rollback
+    counts) and ``replica_eval`` (per-replica validation losses).
+    """
+    epochs = by_kind.get("replica_epoch", [])
+    transitions = by_kind.get("replica_status", [])
+    evals = by_kind.get("replica_eval", [])
+    if not epochs and not transitions:
+        return None
+    per: dict[int, dict] = {}
+    for ev in epochs:
+        r = ev.get("replica")
+        row = per.setdefault(
+            r,
+            {
+                "replica": r,
+                "name": ev.get("name"),
+                "epochs": 0,
+                "last_loss": None,
+                "last_lr": None,
+                "status": "active",
+                "rollbacks": 0,
+                "best_val": None,
+            },
+        )
+        row["epochs"] += 1
+        row["last_loss"] = ev.get("loss")
+        row["last_lr"] = ev.get("lr")
+        row["status"] = ev.get("status", row["status"])
+    for ev in transitions:
+        row = per.get(ev.get("replica"))
+        if row is None:
+            continue
+        row["status"] = ev.get("status", row["status"])
+        row["rollbacks"] = max(
+            row["rollbacks"], ev.get("rollbacks") or 0
+        )
+    for ev in evals:
+        row = per.get(ev.get("replica"))
+        if row is None or ev.get("val_loss") is None:
+            continue
+        if row["best_val"] is None or ev["val_loss"] < row["best_val"]:
+            row["best_val"] = ev["val_loss"]
+    rows = [per[r] for r in sorted(per, key=lambda x: (x is None, x))]
+    return {
+        "count": len(rows),
+        "masked": sum(1 for r in rows if r["status"] == "masked"),
+        "rollbacks": sum(r["rollbacks"] for r in rows),
+        "per_replica": rows,
     }
 
 
@@ -468,6 +526,28 @@ def render_text(report: dict) -> str:
         if util.get("serve_buckets"):
             line += f" | {util['serve_buckets']} serve bucket(s) profiled"
         lines.insert(len(lines) - 1, line)
+    reps = report.get("replicas")
+    if reps:
+        lines.insert(
+            len(lines) - 1,
+            f"replicas       : {reps['count']} stacked, "
+            f"{reps['masked']} masked, {reps['rollbacks']} rollback(s)",
+        )
+        for row in reps["per_replica"]:
+            lines.insert(
+                len(lines) - 1,
+                f"  - {row.get('name') or row.get('replica')}: "
+                f"{row['epochs']} epochs, "
+                f"loss {_fmt(row.get('last_loss'), '.4g')}, "
+                f"lr {_fmt(row.get('last_lr'), '.3g')}, "
+                f"best-val {_fmt(row.get('best_val'), '.4g')}, "
+                f"{row['status']}"
+                + (
+                    f" ({row['rollbacks']} rollback(s))"
+                    if row.get("rollbacks")
+                    else ""
+                ),
+            )
     gs = report.get("grad_sync") or {}
     if gs.get("collectives_per_step") is not None:
         lines.insert(

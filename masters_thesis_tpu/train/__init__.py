@@ -17,6 +17,10 @@ in-tree, TPU-first:
 - :mod:`logging`: TensorBoard scalars/hparams/figures (same taxonomy as the
   reference's TensorBoardLogger).
 - :mod:`trainer`: the fit/test orchestration loop.
+- :mod:`stacked`: R independent replicas (lr/seed grid cells, ensemble
+  members) trained as a leading ``vmap`` axis inside ONE compiled epoch
+  program — one compile and one batched all-reduce per dtype buffer per
+  step regardless of R (TA207).
 """
 
 from masters_thesis_tpu.train.flatparams import (
@@ -26,15 +30,30 @@ from masters_thesis_tpu.train.flatparams import (
     flatten,
     flatten_spec,
     num_buffers,
+    replica_flat,
+    replica_opt_state,
+    stack_flat,
+    stack_opt_states,
+    stacked_size_bytes,
     unflatten,
 )
 from masters_thesis_tpu.train.optim import PlateauScheduler, make_optimizer
+from masters_thesis_tpu.train.stacked import (
+    ReplicaResult,
+    ReplicaSpec,
+    StackedResult,
+    StackedTrainer,
+)
 from masters_thesis_tpu.train.trainer import Trainer, TrainResult
 
 __all__ = [
     "FlatAdam",
     "FlatOptState",
     "PlateauScheduler",
+    "ReplicaResult",
+    "ReplicaSpec",
+    "StackedResult",
+    "StackedTrainer",
     "Trainer",
     "TrainResult",
     "flat_size_bytes",
@@ -42,5 +61,10 @@ __all__ = [
     "flatten_spec",
     "make_optimizer",
     "num_buffers",
+    "replica_flat",
+    "replica_opt_state",
+    "stack_flat",
+    "stack_opt_states",
+    "stacked_size_bytes",
     "unflatten",
 ]

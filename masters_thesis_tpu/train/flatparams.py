@@ -232,6 +232,61 @@ class FlatAdam:
         return unflatten(ubufs, spec), state
 
 
+# ------------------------------------------------------- stacked replicas
+#
+# The stacked training path (train/steps.py:make_stacked_train_epoch) runs
+# R independent replicas — grid cells differing only in lr/seed — as a
+# leading vmap axis over the SAME flat layout: every per-dtype ``[n]``
+# buffer becomes ``[R, n]``, FlatAdam applies elementwise across the stack
+# (per-replica clip norms and bias-correction counts fall out of vmap for
+# free), and per-replica hyperparameters travel as ``[R]`` vectors. The
+# helpers below are the host-side seams: building the stack from R
+# single-replica states and carving one replica back out (for per-cell
+# checkpoints, which stay layout-independent via to_portable).
+
+
+def stack_flat(bufs_list: list) -> dict:
+    """R single-replica buffer dicts ``{key: [n]}`` -> one ``{key: [R, n]}``."""
+    return {k: jnp.stack([b[k] for b in bufs_list]) for k in bufs_list[0]}
+
+
+def replica_flat(stacked: dict, r: int) -> dict:
+    """Carve replica ``r``'s row out of a stacked buffer dict."""
+    return {k: v[r] for k, v in stacked.items()}
+
+
+def stack_opt_states(states: list) -> FlatOptState:
+    """R per-replica FlatOptStates -> one stacked state.
+
+    ``count`` becomes an ``[R]`` int32 vector — replicas that diverge and
+    get rolled back keep their own bias-correction clock, so a recovered
+    replica's Adam trajectory is exactly the one it would have run alone.
+    """
+    return FlatOptState(
+        count=jnp.stack([s.count for s in states]),
+        mu=stack_flat([s.mu for s in states]),
+        nu=stack_flat([s.nu for s in states]),
+    )
+
+
+def replica_opt_state(state: FlatOptState, r: int) -> FlatOptState:
+    """Extract replica ``r``'s single-replica FlatOptState from a stack."""
+    return FlatOptState(
+        count=state.count[r],
+        mu=replica_flat(state.mu, r),
+        nu=replica_flat(state.nu, r),
+    )
+
+
+def stacked_size_bytes(spec: FlatSpec, replicas: int) -> int:
+    """HBM held by one stacked copy of the flat buffers.
+
+    Total stacked-path growth is ~4x this (params + grads + mu + nu) plus
+    activations; docs/perf.md uses it to size R against the memory budget.
+    """
+    return replicas * flat_size_bytes(spec)
+
+
 # -------------------------------------------------- checkpoint portability
 #
 # The on-disk layout must not depend on the flat buffer layout (leaf order

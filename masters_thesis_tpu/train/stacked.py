@@ -1,0 +1,670 @@
+"""Stacked-replica training: N independent runs inside ONE epoch program.
+
+PR 7's rooflines measured what the grid runner pays per cell-as-subprocess:
+a fresh multi-second compile and a chip left nearly empty by the H=64 LSTM
+(the CP403 1%-utilization floor exists because of it). This driver
+multiplies work per compiled program instead: R replicas — grid cells
+differing in lr/seed, ensemble members — train as a leading ``vmap`` axis
+over the flat-buffer layout (train/steps.py:make_stacked_train_epoch).
+One compile, one host dispatch per epoch, one gradient all-reduce per
+dtype buffer per step (TA207), R training runs.
+
+What stays per-replica: init/dropout RNG streams (fold-in per replica
+seed), learning rate (an ``[R]`` vector the per-replica plateau schedulers
+drive), Adam moments + bias-correction counts, metric readbacks, telemetry
+events, checkpoints, and divergence handling — a replica that goes
+non-finite is rolled back to the last fenced-clean snapshot (once, with
+its LR halved) and masked out (lr=0) if it blows up again, while its
+siblings train on untouched. Replica isolation is structural (row r of
+every stacked buffer is a function of row r's inputs only) and pinned
+bit-exactly by tests/test_stacked.py.
+
+Stack-compatibility: replicas must share the model architecture, loss,
+gradient-clip and weight-decay (one program, one clip threshold); lr and
+seed are free per replica. The grid runner groups cells by exactly that
+key (sweeps/run_grid_canonical.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from pathlib import Path
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.parallel import (
+    DATA_AXIS,
+    distributed_run_context,
+    global_put,
+    make_data_mesh,
+    replicated_sharding,
+)
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.telemetry import (
+    CompileTracker,
+    EpochRecorder,
+    TelemetryRun,
+)
+from masters_thesis_tpu.train import checkpoint as ckpt_lib
+from masters_thesis_tpu.train.flatparams import (
+    FlatAdam,
+    flatten,
+    flatten_spec,
+    num_buffers,
+    replica_flat,
+    replica_opt_state,
+    stack_flat,
+    stack_opt_states,
+    stacked_size_bytes,
+    unflatten,
+)
+from masters_thesis_tpu.train.optim import PlateauScheduler
+from masters_thesis_tpu.train.steps import (
+    jit_cache_size,
+    make_eval_fn,
+    make_stacked_train_epoch,
+    metric_means,
+    stacked_metric_means,
+)
+from masters_thesis_tpu.train.trainer import (
+    device_train_split,
+    prepare_eval_split,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSpec:
+    """One replica riding the stack: its identity and free hyperparameters."""
+
+    name: str
+    seed: int
+    learning_rate: float
+
+
+@dataclasses.dataclass
+class ReplicaResult:
+    name: str
+    params: Any  # unflattened final params (rolled-back if masked)
+    opt_state: Any  # single-replica FlatOptState
+    best_val_loss: float
+    history: list[dict]
+    status: str  # active | recovering | masked
+    rollbacks: int
+
+
+@dataclasses.dataclass
+class StackedResult:
+    replicas: list[ReplicaResult]
+    steps_per_sec: float  # program steps/sec (each step trains R cells)
+    epochs: int
+
+    @property
+    def replica_steps_per_sec(self) -> float:
+        return self.steps_per_sec * len(self.replicas)
+
+
+class StackedTrainer:
+    """Drive one stacked epoch program over R replicas.
+
+    Deliberately narrower than :class:`Trainer` (scan mode, FlatAdam, no
+    stream path): it exists for throughput — packing a sweep's worth of
+    runs into one program — not as a second general-purpose fit loop.
+    """
+
+    def __init__(
+        self,
+        max_epochs: int,
+        gradient_clip_val: float | None = None,
+        check_val_every_n_epoch: int = 1,
+        strategy: str = "auto",
+        n_devices: int | None = None,
+        enable_progress_bar: bool = True,
+        ckpt_dir: str | Path | None = None,
+        resume: bool | str = False,
+        preflight: bool = False,
+        telemetry: TelemetryRun | str | Path | None = None,
+        max_replica_rollbacks: int = 1,
+    ):
+        self.max_epochs = max_epochs
+        self.gradient_clip_val = gradient_clip_val
+        self.check_val_every_n_epoch = max(1, int(check_val_every_n_epoch))
+        if strategy == "auto":
+            strategy = "tpu_xla" if len(jax.devices()) > 1 else "single_device"
+        self.strategy = strategy
+        self.mesh = make_data_mesh(
+            1 if strategy == "single_device" else n_devices
+        )
+        self.n_dev = self.mesh.size
+        self.enable_progress_bar = enable_progress_bar
+        self.ckpt_dir = Path(ckpt_dir) if ckpt_dir else None
+        if isinstance(resume, str):
+            resume = resume.lower() in ("true", "auto", "1", "yes")
+        self.resume = resume
+        self.preflight = preflight
+        if isinstance(telemetry, (str, Path)):
+            telemetry = TelemetryRun(telemetry)
+        self.telemetry = telemetry
+        # Divergences tolerated per replica before it is masked: each one
+        # costs a rollback to the last fenced snapshot + an LR halving
+        # (the supervisor's NaN protocol, per replica instead of per run).
+        self.max_replica_rollbacks = max(0, int(max_replica_rollbacks))
+
+    # ----------------------------------------------------------------- fit
+
+    def fit(
+        self,
+        spec: ModelSpec,
+        dm: FinancialWindowDataModule,
+        replicas: Sequence[ReplicaSpec],
+    ) -> StackedResult:
+        if not replicas:
+            raise ValueError("stacked fit needs at least one ReplicaSpec")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        R = len(replicas)
+        tel = self.telemetry
+
+        if self.preflight:
+            from masters_thesis_tpu.analysis.traceaudit import (
+                PreflightError,
+                assert_trace_clean,
+            )
+
+            self._print(
+                f"preflight: trace audit (single + stacked R={R}) ..."
+            )
+            try:
+                assert_trace_clean(
+                    spec=spec, mesh=self.mesh, stacked_replicas=R
+                )
+            except PreflightError as exc:
+                if tel:
+                    tel.event(
+                        "preflight",
+                        status="failed",
+                        rules=sorted({f.rule for f in exc.findings}),
+                        findings=[f.format() for f in exc.findings],
+                    )
+                raise
+            if tel:
+                tel.event("preflight", status="ok", stacked_replicas=R)
+            self._print("preflight: ok")
+
+        dm.prepare_data(verbose=self.enable_progress_bar)
+        dm.setup("fit")
+
+        module = spec.build_module(compute_dtype=jnp.float32)
+        objective = spec.window_objective()
+        tx = FlatAdam(self.gradient_clip_val, spec.weight_decay)
+        dummy = jnp.zeros((1, dm.lookback_window, dm.n_features), jnp.float32)
+
+        # Per-replica init: each replica draws its own init/dropout streams
+        # from its own seed — exactly the streams a solo run would draw.
+        dropout_rngs = []
+        params_list = []
+        for rep in replicas:
+            init_rng, dropout_rng = jax.random.split(jax.random.key(rep.seed))
+            params_list.append(module.init(init_rng, dummy)["params"])
+            dropout_rngs.append(dropout_rng)
+
+        fspec = flatten_spec(params_list[0])
+        schedulers = [PlateauScheduler(rep.learning_rate) for rep in replicas]
+        opt_list = [tx.init(p) for p in params_list]
+        best_vals = [float("inf")] * R
+        start_epoch = 0
+
+        # Resume: only when EVERY replica has a restorable 'last' at the
+        # same epoch — a mixed-epoch stack would silently train replicas
+        # different amounts per program step. Otherwise start fresh.
+        resumed = self._try_resume(replicas, tx, params_list)
+        if resumed is not None:
+            params_list, opt_list, start_epoch, metas = resumed
+            for r, meta in enumerate(metas):
+                if meta.get("best_val") is not None:
+                    best_vals[r] = float(meta["best_val"])
+                if meta.get("scheduler"):
+                    schedulers[r].load_state_dict(meta["scheduler"])
+            self._print(
+                f"resuming all {R} replicas at epoch {start_epoch}"
+            )
+
+        repl = replicated_sharding(self.mesh)
+        pstack = global_put(
+            stack_flat([flatten(p, fspec) for p in params_list]), repl
+        )
+        ostack = global_put(stack_opt_states(opt_list), repl)
+        del params_list, opt_list
+
+        train_dev, n_local = device_train_split(self.mesh, dm.train_arrays())
+        b_local = dm.batch_size
+        steps_per_epoch = n_local // b_local
+        epoch_fn = make_stacked_train_epoch(
+            module, objective, spec.metric_keys, tx, self.mesh, fspec,
+            batch_size=b_local,
+        )
+        eval_fn = make_eval_fn(module, objective, self.mesh)
+        val_prepared = prepare_eval_split(self.mesh, dm.val_arrays())
+
+        statuses = ["active"] * R
+        rollbacks = [0] * R
+        histories: list[list[dict]] = [[] for _ in range(R)]
+
+        # ---- telemetry wiring (same protocol as Trainer.fit, plus the
+        # per-replica sub-streams `replica_epoch` / `replica_status`) ----
+        tracker = rec = flight = None
+        if tel:
+            flight = tel.attach_flight_recorder()
+            flight.beat(phase="setup")
+            tel.event(
+                "run_started",
+                platform=jax.default_backend(),
+                n_devices=self.n_dev,
+                strategy=self.strategy,
+                epoch_mode="stacked_scan",
+                steps_per_epoch=steps_per_epoch,
+                max_epochs=self.max_epochs,
+                start_epoch=start_epoch,
+                objective=spec.objective,
+                trainer="stacked",
+                seed=replicas[0].seed,
+                resumed_from=(
+                    str(self.ckpt_dir) if resumed is not None else None
+                ),
+                distributed=distributed_run_context(),
+                stacked_replicas=R,
+                replicas=[dataclasses.asdict(r) for r in replicas],
+            )
+            tel.gauge("train/collectives_per_step").set(num_buffers(fspec))
+            tel.gauge("train/grad_reduce_bytes").set(
+                stacked_size_bytes(fspec, R)
+            )
+            tel.event(
+                "grad_sync",
+                collectives_per_step=num_buffers(fspec),
+                grad_reduce_bytes=stacked_size_bytes(fspec, R),
+                flat_buffers=num_buffers(fspec),
+                stacked_replicas=R,
+            )
+            tracker = CompileTracker(epoch_fn, size_fn=jit_cache_size)
+            rec = EpochRecorder(tel, steps_per_epoch)
+
+        def active_lrs() -> jax.Array:
+            # Masked replicas ride along at lr=0: their rows stay exactly
+            # at the rolled-back state (u * 0 update) without branching the
+            # program or changing its signature.
+            return global_put(
+                jnp.asarray(
+                    [
+                        0.0 if statuses[r] == "masked" else schedulers[r].lr
+                        for r in range(R)
+                    ],
+                    jnp.float32,
+                ),
+                repl,
+            )
+
+        def epoch_keys(epoch: int) -> jax.Array:
+            return global_put(
+                jnp.stack(
+                    [jax.random.fold_in(k, epoch) for k in dropout_rngs]
+                ),
+                repl,
+            )
+
+        def snapshot(p, o):
+            # Fresh buffers (donation-safe): the snapshot must survive the
+            # next epoch call consuming the live stack.
+            copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)  # noqa: E731
+            return copy(p), copy(o)
+
+        def replica_params(p_stack, r: int):
+            return unflatten(replica_flat(p_stack, r), fspec)
+
+        def emit_replica(epoch, r, means_r, diverged):
+            row = {
+                "epoch": epoch,
+                "lr-Adam": (
+                    0.0 if statuses[r] == "masked" else schedulers[r].lr
+                ),
+            }
+            row.update({f"loss/{k}/train": v for k, v in means_r.items()})
+            if diverged:
+                row["loss/total/train"] = float("nan")
+            histories[r].append(row)
+            if tel:
+                tel.event(
+                    "replica_epoch",
+                    epoch=epoch,
+                    replica=r,
+                    name=replicas[r].name,
+                    loss=row.get("loss/total/train"),
+                    lr=row["lr-Adam"],
+                    status=statuses[r],
+                )
+            return row
+
+        def set_status(r, status, epoch, reason):
+            if statuses[r] == status:
+                return
+            statuses[r] = status
+            self._print(
+                f"epoch {epoch}: replica {replicas[r].name!r} -> {status} "
+                f"({reason})"
+            )
+            if tel:
+                tel.event(
+                    "replica_status",
+                    epoch=epoch,
+                    replica=r,
+                    name=replicas[r].name,
+                    status=status,
+                    reason=reason,
+                    rollbacks=rollbacks[r],
+                )
+
+        last_good = None  # (pstack, ostack) at the last fenced-clean epoch
+
+        def handle_readback(epoch, sums) -> bool:
+            """Per-replica divergence check; True iff NO replica is left.
+
+            A non-finite replica is rolled back to the last fenced-clean
+            snapshot and retried at half its LR; past the rollback budget
+            it is masked (lr=0, rows pinned at the snapshot). Siblings are
+            untouched either way — isolation is structural (row-wise
+            dataflow) and asserted bit-exactly by tests/test_stacked.py.
+            """
+            nonlocal pstack, ostack
+            means = stacked_metric_means(sums, R)
+            for r in range(R):
+                if statuses[r] == "masked":
+                    emit_replica(epoch, r, means[r], diverged=False)
+                    continue
+                loss = means[r].get("total", float("nan"))
+                if faults.fire(
+                    "stacked.replica_loss", epoch=epoch, replica=r
+                ) == "nan":
+                    loss = float("nan")
+                bad = not np.isfinite(loss)
+                emit_replica(epoch, r, means[r], diverged=bad)
+                if not bad:
+                    if statuses[r] == "recovering":
+                        set_status(r, "active", epoch, "finite loss again")
+                    continue
+                rollbacks[r] += 1
+                if last_good is not None:
+                    snap_p, snap_o = last_good
+                    pstack = {
+                        k: v.at[r].set(snap_p[k][r])
+                        for k, v in pstack.items()
+                    }
+                    ostack = ostack._replace(
+                        count=ostack.count.at[r].set(snap_o.count[r]),
+                        mu={
+                            k: v.at[r].set(snap_o.mu[k][r])
+                            for k, v in ostack.mu.items()
+                        },
+                        nu={
+                            k: v.at[r].set(snap_o.nu[k][r])
+                            for k, v in ostack.nu.items()
+                        },
+                    )
+                if rollbacks[r] > self.max_replica_rollbacks:
+                    set_status(
+                        r, "masked", epoch,
+                        "rollback budget exhausted; frozen at last good "
+                        "state",
+                    )
+                else:
+                    schedulers[r].lr *= 0.5
+                    set_status(
+                        r, "recovering", epoch,
+                        f"non-finite loss; rolled back, lr halved to "
+                        f"{schedulers[r].lr:.3g}",
+                    )
+            return all(s == "masked" for s in statuses)
+
+        history_rows: list[dict] = []  # (epoch, sums) readback pipeline
+        pending: tuple[int, Any] | None = None
+        t_start = None
+        total_steps = 0
+        all_dead = False
+
+        for epoch in range(start_epoch, self.max_epochs):
+            if flight is not None:
+                flight.beat(phase="train", epoch=epoch)
+            if rec:
+                rec.begin(epoch)
+            pstack, ostack, sums = epoch_fn(
+                pstack, ostack, active_lrs(), epoch_keys(epoch), train_dev
+            )
+            total_steps += steps_per_epoch
+            if rec:
+                rec.dispatched(compiles=tracker.poll())
+
+            if pending is not None:
+                prev_epoch, prev_sums = pending
+                pending = None
+                all_dead = handle_readback(prev_epoch, prev_sums)
+                if all_dead:
+                    break
+
+            is_val = (
+                (epoch + 1) % self.check_val_every_n_epoch == 0
+                and val_prepared
+            )
+            if is_val or t_start is None:
+                # Fenced path: block on this epoch's sums, validate every
+                # replica, and only THEN snapshot — last_good never holds a
+                # poisoned stack.
+                t_fence = time.perf_counter()
+                all_dead = handle_readback(epoch, sums)
+                if rec:
+                    rec.fenced(time.perf_counter() - t_fence)
+                    tel.sample_memory(epoch)
+                if t_start is None:
+                    t_start = time.perf_counter()
+                if all_dead:
+                    break
+                last_good = snapshot(pstack, ostack)
+                if is_val:
+                    self._run_val(
+                        epoch, pstack, eval_fn, val_prepared, replicas,
+                        schedulers, statuses, best_vals, histories, tx,
+                        fspec, ostack, spec, dm, tel,
+                    )
+            else:
+                pending = (epoch, sums)
+
+        if pending is not None and not all_dead:
+            all_dead = handle_readback(*pending)
+
+        jax.block_until_ready(pstack)
+        if rec:
+            rec.finish()
+        elapsed = time.perf_counter() - (t_start or time.perf_counter())
+        post_compile_steps = total_steps - steps_per_epoch
+        steps_per_sec = (
+            post_compile_steps / elapsed
+            if elapsed > 0 and post_compile_steps > 0
+            else 0.0
+        )
+
+        # Final per-replica checkpoints: masked replicas were rolled back
+        # to their last clean state, so 'last' is always safe to restore.
+        results = []
+        pstack_h = jax.device_get(pstack)
+        ostack_h = jax.device_get(ostack)
+        for r, rep in enumerate(replicas):
+            params_r = replica_params(pstack_h, r)
+            opt_r = replica_opt_state(ostack_h, r)
+            if self.ckpt_dir:
+                self._save_replica(
+                    rep, "last", params_r, opt_r, spec, dm,
+                    self.max_epochs - 1, best_vals[r], schedulers[r],
+                    statuses[r],
+                )
+            results.append(
+                ReplicaResult(
+                    name=rep.name,
+                    params=params_r,
+                    opt_state=opt_r,
+                    best_val_loss=best_vals[r],
+                    history=histories[r],
+                    status=statuses[r],
+                    rollbacks=rollbacks[r],
+                )
+            )
+
+        if tel:
+            if flight is not None:
+                flight.beat(phase="finished")
+            tel.sample_memory(None)
+            tel.event(
+                "run_finished",
+                epochs=max((len(h) for h in histories), default=0),
+                total_steps=total_steps,
+                steps_per_sec=steps_per_sec,
+                diverged=all_dead,
+                best_val=min(
+                    (v for v in best_vals if np.isfinite(v)), default=None
+                ),
+                epoch_compiles=tracker.total,
+                eval_compiles=0,
+                stacked_replicas=R,
+                replica_status={
+                    replicas[r].name: statuses[r] for r in range(R)
+                },
+            )
+            tel.snapshot_metrics()
+
+        del history_rows
+        return StackedResult(
+            replicas=results,
+            steps_per_sec=steps_per_sec,
+            epochs=self.max_epochs - start_epoch,
+        )
+
+    # ------------------------------------------------------------- helpers
+
+    def _run_val(
+        self, epoch, pstack, eval_fn, val_prepared, replicas, schedulers,
+        statuses, best_vals, histories, tx, fspec, ostack, spec, dm, tel,
+    ):
+        """Per-replica validation through ONE compiled eval program.
+
+        Row extraction is a device-side slice; all R calls share the same
+        (shape, sharding) signature, so eval compiles once regardless of R.
+        """
+        for r, rep in enumerate(replicas):
+            if statuses[r] == "masked":
+                continue
+            params_r = unflatten(replica_flat(pstack, r), fspec)
+            val_sums = eval_fn(params_r, *val_prepared)
+            val_metrics = metric_means(jax.device_get(val_sums))
+            val_loss = val_metrics["total"]
+            if histories[r] and histories[r][-1]["epoch"] == epoch:
+                histories[r][-1].update(
+                    {f"loss/{k}/val": v for k, v in val_metrics.items()}
+                )
+            schedulers[r].step(val_loss)
+            if tel:
+                tel.event(
+                    "replica_eval",
+                    epoch=epoch,
+                    replica=r,
+                    name=rep.name,
+                    val_loss=float(val_loss),
+                )
+            if val_loss < best_vals[r] and self.ckpt_dir:
+                best_vals[r] = val_loss
+                self._save_replica(
+                    rep, "best",
+                    jax.device_get(params_r),
+                    replica_opt_state(jax.device_get(ostack), r),
+                    spec, dm, epoch, best_vals[r], schedulers[r],
+                    statuses[r],
+                )
+            elif val_loss < best_vals[r]:
+                best_vals[r] = val_loss
+
+    def _replica_dir(self, rep: ReplicaSpec) -> Path:
+        return self.ckpt_dir / rep.name
+
+    def _try_resume(self, replicas, tx, params_list):
+        if not (self.resume and self.ckpt_dir):
+            return None
+        restorable = all(
+            ckpt_lib.checkpoint_restorable(self._replica_dir(rep), "last")
+            for rep in replicas
+        )
+        if not restorable:
+            return None
+        from masters_thesis_tpu.train.checkpoint import (
+            restore_checkpoint,
+            restore_opt_state,
+        )
+
+        new_params, new_opts, metas, epochs = [], [], [], set()
+        for rep, template_params in zip(replicas, params_list):
+            r_params, r_opt, _, r_meta = restore_checkpoint(
+                self._replica_dir(rep), "last"
+            )
+            params = jax.tree_util.tree_map(jnp.asarray, r_params)
+            template = jax.device_get(tx.init(template_params))
+            new_params.append(params)
+            new_opts.append(restore_opt_state(template, r_opt, params=params))
+            metas.append(r_meta)
+            epochs.add(int(r_meta.get("epoch", -1)))
+        if len(epochs) != 1:
+            self._print(
+                f"resume skipped: replica checkpoints at mixed epochs "
+                f"{sorted(epochs)}; starting fresh"
+            )
+            return None
+        return new_params, new_opts, epochs.pop() + 1, metas
+
+    def _save_replica(
+        self, rep, tag, params, opt_state, spec, dm, epoch, best_val,
+        scheduler, status,
+    ):
+        ckpt_lib.save_checkpoint(
+            self._replica_dir(rep), tag, params, opt_state, spec,
+            meta={
+                "epoch": epoch,
+                "val_loss": float(best_val),
+                "scheduler": scheduler.state_dict(),
+                "best_val": (
+                    None if not np.isfinite(best_val) else float(best_val)
+                ),
+                "trainer": "stacked",
+                "replica": dataclasses.asdict(rep),
+                "replica_status": status,
+                "datamodule": {
+                    "lookback_window": dm.lookback_window,
+                    "target_window": dm.target_window,
+                    "stride": dm.stride,
+                    "prediction_task": dm.prediction_task,
+                    "interaction_only": dm.interaction_only,
+                    "batch_size": dm.batch_size,
+                },
+            },
+        )
+        if self.telemetry:
+            self.telemetry.event(
+                "checkpoint_saved",
+                tag=tag,
+                epoch=epoch,
+                replica=rep.name,
+                path=str(self._replica_dir(rep) / tag),
+            )
+
+    def _print(self, msg: str) -> None:
+        if self.enable_progress_bar and jax.process_index() == 0:
+            print(msg, flush=True)

@@ -110,6 +110,67 @@ def _make_loss_fn(module, window_objective: WindowObjective):
     return loss_fn
 
 
+def _flat_epoch_body(
+    loss_fn,
+    tx,
+    spec,
+    metric_keys: tuple,
+    batch_size: int,
+) -> Callable:
+    """Shard-local one-epoch body over FLAT buffers, shared by the single
+    and stacked paths.
+
+    Signature: ``body(pbufs, opt_state, lr, rng, data) -> (pbufs,
+    opt_state, local_sums)``. Every numeric op the single-replica flat path
+    runs lives here, so the stacked path (which maps this body over a
+    leading replica axis) is per-replica the SAME op sequence — vmap of
+    elementwise/optimizer ops is per-lane bit-identical, and the batched
+    ``lax.pmean`` still lowers to one all-reduce per dtype buffer (TA206,
+    and TA207 for the stacked program).
+    """
+
+    def body(pbufs, opt_state, lr, rng, data: Batch):
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        shuffle_rng, dropout_rng = jax.random.split(rng)
+        n_local = data.x.shape[0]
+        n_steps = n_local // batch_size
+        perm = jax.random.permutation(shuffle_rng, n_local)
+        idx = perm[: n_steps * batch_size].reshape(n_steps, batch_size)
+
+        def step(carry, inp):
+            pbufs, opt_state, sums = carry
+            i, batch_idx = inp
+            step_rng = jax.random.fold_in(dropout_rng, i)
+            batch = Batch(
+                *(jnp.take(a, batch_idx, axis=0) for a in data)
+            )
+            params_t = unflatten(pbufs, spec)
+            (_, step_sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params_t, step_rng, batch
+            )
+            # Equal per-device batch sizes => pmean of local-mean grads is
+            # the global-batch gradient (the DDP all-reduce, on ICI).
+            # ONE collective per step: the whole gradient crosses ICI as
+            # a single contiguous buffer per dtype (TA206 pins this in
+            # the lowered HLO) instead of one all-reduce per pytree leaf.
+            gbufs = lax.pmean(flatten(grads, spec), DATA_AXIS)
+            ubufs, opt_state = tx.update_flat(gbufs, opt_state, pbufs, spec)
+            pbufs = {
+                k: p - lr * ubufs[k].astype(p.dtype)
+                for k, p in pbufs.items()
+            }
+            sums = _accumulate(sums, step_sums)
+            return (pbufs, opt_state, sums), None
+
+        zero = _zero_sums(tuple(metric_keys) + ("total",))
+        (pbufs, opt_state, sums), _ = lax.scan(
+            step, (pbufs, opt_state, zero), (jnp.arange(n_steps), idx)
+        )
+        return pbufs, opt_state, sums
+
+    return body
+
+
 def make_train_epoch(
     module,
     window_objective: WindowObjective,
@@ -137,16 +198,26 @@ def make_train_epoch(
     flat = isinstance(tx, FlatAdam)
 
     def local_epoch(params, opt_state, lr, rng, data: Batch):
+        if flat:
+            # Flat path: the scan carries params as per-dtype flat buffers;
+            # the view table is static (trace-time Python), so pack/unpack
+            # are pure layout ops XLA folds into the neighbouring
+            # computation. The body is shared with the stacked path.
+            spec = flatten_spec(params)
+            body = _flat_epoch_body(loss_fn, tx, spec, metric_keys, batch_size)
+            pbufs, opt_state, sums = body(
+                flatten(params, spec), opt_state, lr, rng, data
+            )
+            params = unflatten(pbufs, spec)
+            sums = lax.psum(sums, DATA_AXIS)
+            return params, opt_state, sums
+
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
         shuffle_rng, dropout_rng = jax.random.split(rng)
         n_local = data.x.shape[0]
         n_steps = n_local // batch_size
         perm = jax.random.permutation(shuffle_rng, n_local)
         idx = perm[: n_steps * batch_size].reshape(n_steps, batch_size)
-        # Flat path: the scan carries params as per-dtype flat buffers; the
-        # view table is static (trace-time Python), so pack/unpack are pure
-        # layout ops XLA folds into the neighbouring computation.
-        spec = flatten_spec(params) if flat else None
 
         def step(carry, inp):
             params, opt_state, sums = carry
@@ -155,40 +226,21 @@ def make_train_epoch(
             batch = Batch(
                 *(jnp.take(a, batch_idx, axis=0) for a in data)
             )
-            params_t = unflatten(params, spec) if flat else params
             (_, step_sums), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params_t, step_rng, batch
+                params, step_rng, batch
             )
-            # Equal per-device batch sizes => pmean of local-mean grads is
-            # the global-batch gradient (the DDP all-reduce, on ICI).
-            if flat:
-                # ONE collective per step: the whole gradient crosses ICI as
-                # a single contiguous buffer per dtype (TA206 pins this in
-                # the lowered HLO) instead of one all-reduce per pytree leaf.
-                gbufs = lax.pmean(flatten(grads, spec), DATA_AXIS)
-                ubufs, opt_state = tx.update_flat(
-                    gbufs, opt_state, params, spec
-                )
-                params = {
-                    k: p - lr * ubufs[k].astype(p.dtype)
-                    for k, p in params.items()
-                }
-            else:
-                grads = lax.pmean(grads, DATA_AXIS)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = jax.tree_util.tree_map(
-                    lambda p, u: p - lr * u.astype(p.dtype), params, updates
-                )
+            grads = lax.pmean(grads, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p - lr * u.astype(p.dtype), params, updates
+            )
             sums = _accumulate(sums, step_sums)
             return (params, opt_state, sums), None
 
         zero = _zero_sums(tuple(metric_keys) + ("total",))
-        carry0 = (flatten(params, spec) if flat else params, opt_state, zero)
         (params, opt_state, sums), _ = lax.scan(
-            step, carry0, (jnp.arange(n_steps), idx)
+            step, (params, opt_state, zero), (jnp.arange(n_steps), idx)
         )
-        if flat:
-            params = unflatten(params, spec)
         sums = lax.psum(sums, DATA_AXIS)
         return params, opt_state, sums
 
@@ -212,6 +264,102 @@ def make_train_epoch(
         in_shardings=(repl, repl, repl, repl, batch_sh),
         out_shardings=(repl, repl, repl),
     )
+
+
+def make_stacked_train_epoch(
+    module,
+    window_objective: WindowObjective,
+    metric_keys: tuple,
+    tx,
+    mesh: Mesh,
+    spec,
+    batch_size: int = 1,
+) -> Callable:
+    """Build the STACKED one-epoch program: R replicas, one XLA program.
+
+    Independent training replicas (grid cells over lr/seed, ensemble
+    members) run as a leading ``vmap`` axis over the shared flat epoch
+    body. Returned signature (all device values)::
+
+        epoch_fn(pstack, opt_state, lrs, rngs, data)
+            -> (pstack, opt_state, metric_sums)
+
+    where ``pstack`` is the stacked flat-buffer dict ``{key: [R, n]}``
+    (see flatparams.stack_flat), ``opt_state`` a stacked FlatOptState
+    (``count [R]``, moments ``[R, n]``), ``lrs`` an ``[R]`` float32
+    vector of per-replica learning rates, and ``rngs`` an ``[R]`` typed
+    PRNG key array (one independent seed stream per replica). ``data`` is
+    the train split sharded on its window axis exactly as in
+    :func:`make_train_epoch` — replicas share the data plane, so HBM
+    grows only by the stacked params/grads/moments (~4x
+    ``flatparams.stacked_size_bytes``), not by R copies of the dataset.
+
+    Why this multiplies cells/hour: every replica reuses ONE compile, ONE
+    host dispatch per epoch, and ONE gradient all-reduce per dtype buffer
+    per step — ``lax.pmean`` under ``vmap`` batches into a single
+    collective over the ``[R, n]`` buffer (trace-audit rule TA207 pins
+    this, the stacked extension of TA206). Per-replica numerics: the body
+    is the same op sequence per lane, so RNG streams, the clip norm, and
+    the whole Adam update are per-replica bit-identical to independent
+    runs; only batched matmul kernels may reassociate at the ULP level
+    (measured ~1e-9 on XLA:CPU — see tests/test_stacked.py and
+    docs/perf.md for the exact parity contract).
+
+    Replica isolation is structural: row r of every buffer is a function
+    of row r's inputs only (elementwise optimizer, per-replica reductions,
+    per-replica pmean rows), so a diverged replica's NaNs never reach its
+    siblings — the trainer can roll back or mask one row while the rest
+    keep training (tested bit-exactly in tests/test_stacked.py).
+    """
+
+    loss_fn = _make_loss_fn(module, window_objective)
+    if not isinstance(tx, FlatAdam):
+        raise TypeError("stacked training requires the flat-buffer FlatAdam")
+    body = _flat_epoch_body(loss_fn, tx, spec, metric_keys, batch_size)
+
+    def local_epoch(pstack, opt_state, lrs, rngs, data: Batch):
+        # Replicas share the local data shard; everything else is mapped.
+        pstack, opt_state, sums = jax.vmap(
+            body, in_axes=(0, 0, 0, 0, None)
+        )(pstack, opt_state, lrs, rngs, data)
+        # Per-replica metric sums: leaves become [R]; one psum outside the
+        # step scan, exactly like the single path.
+        sums = lax.psum(sums, DATA_AXIS)
+        return pstack, opt_state, sums
+
+    data_spec = Batch(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+    sharded = shard_map(
+        local_epoch,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), data_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    repl = NamedSharding(mesh, P())
+    batch_sh = Batch(*(NamedSharding(mesh, s) for s in data_spec))
+    return jax.jit(
+        sharded,
+        donate_argnums=(0, 1),
+        in_shardings=(repl, repl, repl, repl, batch_sh),
+        out_shardings=(repl, repl, repl),
+    )
+
+
+def stacked_metric_means(sums: dict, replicas: int) -> list:
+    """Host-side: per-replica means from stacked (value, weight) sums.
+
+    The stacked program's metric leaves are ``[R]`` arrays; one
+    ``device_get`` on the dict then R cheap slices — the readback cost does
+    not grow with R beyond the tiny metric vectors themselves.
+    """
+    host = jax.device_get(sums)
+    return [
+        {
+            k: float(v[r]) / max(float(w[r]), 1e-30)
+            for k, (v, w) in host.items()
+        }
+        for r in range(replicas)
+    ]
 
 
 def make_train_step(

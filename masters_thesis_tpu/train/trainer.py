@@ -68,6 +68,49 @@ from masters_thesis_tpu.train.steps import (
 EVAL_CHUNK = 32
 
 
+def device_train_split(mesh, arrays: Batch) -> tuple[Batch, int]:
+    """Shard the train split over the mesh; returns (device batch, n_local).
+
+    Truncates to a multiple of the mesh size (<= n_dev-1 windows dropped;
+    every window still rotates in via the per-epoch shard-local shuffle
+    being re-drawn — matches DDP sampler semantics). Module-level so the
+    stacked trainer (train/stacked.py) prepares data identically to the
+    single-run Trainer — replicas share one device-resident split.
+    """
+    n_dev = mesh.size
+    n = arrays.x.shape[0]
+    n_local = n // n_dev
+    if n_local == 0:
+        raise ValueError(f"train split has {n} windows < mesh size {n_dev}")
+    trunc = jax.tree_util.tree_map(lambda a: a[: n_local * n_dev], arrays)
+    return global_put(trunc, batch_sharding(mesh)), n_local
+
+
+def prepare_eval_split(mesh, arrays: Batch) -> tuple[Batch, jax.Array] | None:
+    """Pad + reshape a split to (steps, n_dev*chunk, ...) with a mask."""
+    n_dev = mesh.size
+    n = arrays.x.shape[0]
+    if n == 0:
+        return None
+    global_chunk = n_dev * min(EVAL_CHUNK, max(1, n // n_dev))
+    steps = -(-n // global_chunk)
+    padded = steps * global_chunk
+
+    def pad_reshape(a):
+        a = np.asarray(a)
+        widths = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths).reshape(steps, global_chunk, *a.shape[1:])
+
+    mask = np.zeros((padded,), np.float32)
+    mask[:n] = 1.0
+    mask = mask.reshape(steps, global_chunk)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+    batch = global_put(jax.tree_util.tree_map(pad_reshape, arrays), sharding)
+    return batch, global_put(mask, sharding)
+
+
 @dataclasses.dataclass
 class TrainResult:
     params: Any
@@ -209,50 +252,10 @@ class Trainer:
     # ----------------------------------------------------------- data prep
 
     def _device_train_split(self, arrays: Batch) -> tuple[Batch, int]:
-        """Shard the train split over the mesh; returns (device batch, n_local).
-
-        Truncates to a multiple of the mesh size (<= n_dev-1 windows dropped;
-        every window still rotates in via the per-epoch shard-local shuffle
-        being re-drawn — matches DDP sampler semantics).
-        """
-        n = arrays.x.shape[0]
-        n_local = n // self.n_dev
-        if n_local == 0:
-            raise ValueError(
-                f"train split has {n} windows < mesh size {self.n_dev}"
-            )
-        trunc = jax.tree_util.tree_map(
-            lambda a: a[: n_local * self.n_dev], arrays
-        )
-        return (
-            global_put(trunc, batch_sharding(self.mesh)),
-            n_local,
-        )
+        return device_train_split(self.mesh, arrays)
 
     def _eval_split(self, arrays: Batch) -> tuple[Batch, jax.Array] | None:
-        """Pad + reshape a split to (steps, n_dev*chunk, ...) with a mask."""
-        n = arrays.x.shape[0]
-        if n == 0:
-            return None
-        global_chunk = self.n_dev * min(EVAL_CHUNK, max(1, n // self.n_dev))
-        steps = -(-n // global_chunk)
-        padded = steps * global_chunk
-
-        def pad_reshape(a):
-            a = np.asarray(a)
-            widths = [(0, padded - n)] + [(0, 0)] * (a.ndim - 1)
-            return np.pad(a, widths).reshape(steps, global_chunk, *a.shape[1:])
-
-        mask = np.zeros((padded,), np.float32)
-        mask[:n] = 1.0
-        mask = mask.reshape(steps, global_chunk)
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        sharding = NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
-        batch = global_put(
-            jax.tree_util.tree_map(pad_reshape, arrays), sharding
-        )
-        return batch, global_put(mask, sharding)
+        return prepare_eval_split(self.mesh, arrays)
 
     # ----------------------------------------------------------------- fit
 

@@ -25,6 +25,13 @@ Engineering constraints this runner absorbs:
   checkpoint INSIDE the cell's budget, re-running this script resumes
   truncated cells instead of restarting, and completed cells are skipped
   via the results JSONL.
+- ``--stack-seeds N`` expands every synthetic cell into N seed replicas
+  and trains each stack-compatible group — same (model, loss, trainer),
+  differing seed — as ONE supervised stacked process
+  (sweeps/stacked_cell.py -> train/stacked.py): one compile and one
+  batched gradient all-reduce per step for the whole group, per-cell
+  heartbeats/JSONL rows/resume preserved. Warmup cells keep the
+  per-cell subprocess path.
 
 Results: one JSON line per finished cell in results/grid_r3.jsonl
 (training wall, best-val, and the ΔL-above-OLS table numbers via
@@ -174,6 +181,7 @@ def train_with_retry(
     budget: float,
     deadline: float,
     ckpt: Path | None = None,
+    cmd: list[str] | None = None,
 ) -> tuple[bool, bool]:
     """Run train.py (with resume) under the resilience supervisor, within
     a wall budget. Returns ``(completed, truncated)``: completed means the
@@ -191,8 +199,8 @@ def train_with_retry(
     budget = min(budget, max(60.0, deadline - time.time()))
     log_dir = ckpt.parent.parent if ckpt is not None else None
     sup = RunSupervisor(
-        [sys.executable, "train.py", *train_overrides,
-         "trainer.resume=auto", "trainer.enable_model_summary=false"],
+        cmd or [sys.executable, "train.py", *train_overrides,
+                "trainer.resume=auto", "trainer.enable_model_summary=false"],
         run_dir=(log_dir / "supervisor") if log_dir else RESULTS_DIR / "supervisor" / cell,
         cfg=SupervisorConfig(
             max_retries=2,
@@ -423,11 +431,114 @@ def postmortem_headline(ckpt: Path) -> dict | None:
     }
 
 
+def run_stacked_group(
+    loss: str, model: str, trainer_name: str, seeds: list[int],
+    deadline: float,
+) -> None:
+    """Train a stack-compatible group of seed cells in ONE supervised
+    stacked process (sweeps/stacked_cell.py -> train.stacked).
+
+    Same contracts as run_cell, per cell of the group: cells already
+    recorded complete are not retrained (the stacked child only gets the
+    PENDING replicas), each cell keeps its own heartbeat file and its own
+    results-JSONL row, a budget-cut group is recorded truncated and a
+    re-run resumes every replica from its last common checkpoint.
+    Supervisor preemption/crash retries relaunch the whole group; a
+    replica that diverges is rolled back or masked individually by the
+    stacked trainer without costing its siblings the run.
+    """
+    group = f"{loss}_{model}_{trainer_name}_stack"
+    names = {s: f"{loss}_{model}_{trainer_name}_s{s}" for s in seeds}
+    done = done_cells()
+    pending = [s for s in seeds if names[s] not in done]
+    if not pending:
+        log(f"skip {group}: all {len(seeds)} cells recorded")
+        return
+    if not wait_for_tpu(deadline):
+        log(f"skip {group}: TPU never became ready before deadline")
+        return
+    maybe_run_bench(deadline)
+    budget = min(PER_CELL_CAP_S, deadline - time.time())
+    if budget < 300:
+        log(f"skip {group}: deadline reached")
+        return
+
+    ckpt_root = (REPO / "logs/FinancialLstm/synthetic_stacked"
+                 / version_for(loss, model, trainer_name))
+    replicas = [{"name": f"s{s}", "seed": s} for s in pending]
+    log(f"train {group}: {len(pending)} stacked cell(s) "
+        f"{[names[s] for s in pending]}")
+    for s in pending:
+        cell_heartbeat(names[s], "train", stack_group=group,
+                       budget_s=round(budget, 1))
+    t0 = time.time()
+    completed, truncated = train_with_retry(
+        group, [], budget, deadline,
+        ckpt=ckpt_root / "checkpoints" / "group",
+        cmd=[sys.executable, "sweeps/stacked_cell.py",
+             f"model={model}", f"loss={loss}", f"trainer={trainer_name}",
+             "--replicas", json.dumps(replicas),
+             "--ckpt-dir", str(ckpt_root)],
+    )
+    if not completed and not truncated:
+        for s in pending:
+            cell_heartbeat(names[s], "failed", stack_group=group)
+        return
+    wall = time.time() - t0
+    if truncated:
+        log(f"{group}: evaluating the last per-replica checkpoints")
+
+    for s in pending:
+        cell = names[s]
+        ckpt = ckpt_root / f"s{s}" / "best"
+        cell_heartbeat(cell, "eval", stack_group=group, truncated=truncated)
+        if not ckpt.exists():
+            log(f"{cell}: no checkpoint at {ckpt}; nothing to record")
+            cell_heartbeat(cell, "failed", stack_group=group)
+            continue
+        try:
+            ev = subprocess.run(
+                [sys.executable, "sweeps/eval_cell.py", f"checkpoint={ckpt}",
+                 "datamodule=synthetic"],
+                cwd=REPO,
+                timeout=1800,
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (subprocess.TimeoutExpired,
+                subprocess.CalledProcessError) as exc:
+            err = getattr(exc, "stderr", "") or ""
+            log(f"{cell}: eval failed ({type(exc).__name__})\n{err[-1500:]}")
+            cell_heartbeat(cell, "failed", stage="eval", stack_group=group)
+            continue
+        row = json.loads(ev.stdout.strip().splitlines()[-1])
+        row.update({"cell": cell, "stack_group": group, "seed": s,
+                    "train_wall_s": round(wall, 1),
+                    "truncated": truncated,
+                    "telemetry": telemetry_summary(ckpt)})
+        RESULTS_DIR.mkdir(exist_ok=True)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        cell_heartbeat(cell, "done", stack_group=group, truncated=truncated,
+                       wall_s=round(wall, 1))
+        log(f"{cell}: recorded (stacked, wall {wall:.0f}s shared, "
+            f"truncated={truncated})")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--deadline", required=True,
         help="ISO time (local) after which no new cells launch",
+    )
+    parser.add_argument(
+        "--stack-seeds", type=int, default=1, metavar="N",
+        help="expand each synthetic grid cell into N seed replicas and "
+        "train each (model, loss, trainer) group as ONE stacked process "
+        "(train/stacked.py); 1 (default) keeps the canonical per-cell "
+        "subprocess path. Warmup cells always use the subprocess path — "
+        "warm-started runs are not stack-compatible with scratch runs.",
     )
     args = parser.parse_args()
     deadline = datetime.datetime.fromisoformat(args.deadline).timestamp()
@@ -437,6 +548,12 @@ def main() -> None:
     # ---- 1. slow column, cheapest models first --------------------------
     for model in MODELS:
         for loss in LOSSES:
+            if args.stack_seeds > 1:
+                run_stacked_group(
+                    loss, model, "slow",
+                    list(range(args.stack_seeds)), deadline,
+                )
+                continue
             cell = f"{loss}_{model}_slow"
             ckpt = (REPO / "logs/FinancialLstm/synthetic"
                     / version_for(loss, model, "slow") / "checkpoints/best")
@@ -503,6 +620,12 @@ def main() -> None:
     # ---- 3. slowest column, cheapest models first -----------------------
     for model in MODELS:
         for loss in LOSSES:
+            if args.stack_seeds > 1:
+                run_stacked_group(
+                    loss, model, "slowest",
+                    list(range(args.stack_seeds)), deadline,
+                )
+                continue
             cell = f"{loss}_{model}_slowest"
             ckpt = (REPO / "logs/FinancialLstm/synthetic"
                     / version_for(loss, model, "slowest") / "checkpoints/best")

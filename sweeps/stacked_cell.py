@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Train a stack-compatible group of grid cells in ONE compiled program.
+
+The grid runner (run_grid_canonical.py) groups cells that share
+(model, loss, trainer) and differ only in seed / learning rate — exactly
+the stack-compatibility contract of masters_thesis_tpu.train.stacked —
+and launches this script once per group under the resilience supervisor.
+Each replica gets its own checkpoints under ``<ckpt-dir>/<name>/`` in the
+same layout train.py produces, so sweeps/eval_cell.py evaluates each cell
+of the group unchanged.
+
+Usage::
+
+    python sweeps/stacked_cell.py model=small loss=mse trainer=slow \
+        --replicas '[{"name": "s0", "seed": 0}, {"name": "s1", "seed": 1}]' \
+        --ckpt-dir logs/FinancialLstm/synthetic_stacked/mse_small_slow
+
+Replica entries take an optional ``"lr"``; omitted means the config's
+model.learning_rate. Prints ONE JSON line with per-replica outcomes; exit
+0 iff at least one replica finished unmasked (the supervisor treats
+nonzero like any crashed training attempt).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from train import CONFIG_DIR, bootstrap, build_datamodule, build_spec  # noqa: E402
+from masters_thesis_tpu.config import compose  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("overrides", nargs="*", help="key=value overrides")
+    parser.add_argument(
+        "--replicas", required=True,
+        help='JSON list of {"name", "seed", optional "lr"} entries',
+    )
+    parser.add_argument(
+        "--ckpt-dir", required=True, type=Path,
+        help="root dir; each replica checkpoints under <ckpt-dir>/<name>/",
+    )
+    parser.add_argument(
+        "--max-epochs", type=int, default=None,
+        help="override trainer.max_epochs from the composed config",
+    )
+    args = parser.parse_args()
+
+    cfg = compose(str(CONFIG_DIR), overrides=args.overrides)
+    if not bootstrap(cfg):
+        return 1
+    dm = build_datamodule(cfg)
+    spec = build_spec(cfg)
+
+    from masters_thesis_tpu.train import ReplicaSpec, StackedTrainer
+    from masters_thesis_tpu.utils import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+    replicas = [
+        ReplicaSpec(
+            name=str(r["name"]),
+            seed=int(r["seed"]),
+            learning_rate=float(r.get("lr") or spec.learning_rate),
+        )
+        for r in json.loads(args.replicas)
+    ]
+
+    t = cfg.trainer
+    trainer = StackedTrainer(
+        max_epochs=args.max_epochs or t.max_epochs,
+        gradient_clip_val=t.gradient_clip_val,
+        check_val_every_n_epoch=t.get("check_val_every_n_epoch", 1),
+        strategy=t.strategy,
+        n_devices=t.get("n_devices", None),
+        enable_progress_bar=t.enable_progress_bar,
+        ckpt_dir=args.ckpt_dir,
+        # The supervisor relaunches this process after preemptions/crashes;
+        # resume picks the group up at its last common 'last' epoch.
+        resume=True,
+        preflight=t.get("preflight", False),
+        telemetry=args.ckpt_dir / "telemetry",
+    )
+    result = trainer.fit(spec, dm, replicas)
+
+    rows = [
+        {
+            "name": r.name,
+            "status": r.status,
+            "best_val": (
+                r.best_val_loss if math.isfinite(r.best_val_loss) else None
+            ),
+            "rollbacks": r.rollbacks,
+            "checkpoint": str(args.ckpt_dir / r.name / "best"),
+        }
+        for r in result.replicas
+    ]
+    print(json.dumps({
+        "replicas": rows,
+        "steps_per_sec": result.steps_per_sec,
+        "epochs": result.epochs,
+    }))
+    return 0 if any(r.status != "masked" for r in result.replicas) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,553 @@
+"""Stacked-replica training path: parity vs independent runs, bitwise
+replica isolation, TA207 collective/compile invariants, stacked opt-state
+checkpoint round-trip, per-replica divergence handling.
+
+Parity contract (what is bitwise and what is not): the stacked program is
+the SAME epoch body as the single-replica flat path, batched by ``vmap``
+over a leading replica axis. Everything host-controlled or elementwise is
+bit-identical per lane — RNG folds/splits/permutations, the fused Adam
+update including the clip-norm reduction, the lr application — and replica
+ISOLATION is bitwise end to end (row r of every stacked buffer is a
+function of row r's inputs only). The one layer that is NOT bitwise on
+XLA:CPU is the batched LSTM gemm backward: batching a gemm changes how XLA
+reassociates the reduction, so gradients drift at ULP scale (~1e-9) and
+compound to ~1e-6 relative in params over a few epochs. On TPU the MXU
+accumulates in a shape-invariant systolic order, so this gap is
+CPU-specific. The end-to-end test therefore pins the first epoch's metric
+sums bitwise (identical starting params, reassociation-stable forward)
+and later epochs/params to a tight tolerance, while the optimizer-layer
+and isolation tests assert exact equality.
+"""
+
+import tempfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.analysis.traceaudit import (
+    AUDIT_BATCH,
+    AUDIT_FEATURES,
+    AUDIT_LOOKBACK,
+    _synthetic_split,
+    count_step_collectives,
+    run_stacked_trace_audit,
+)
+from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.parallel import (
+    batch_sharding,
+    global_put,
+    make_data_mesh,
+    replicated_sharding,
+)
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.train import ReplicaSpec, StackedTrainer
+from masters_thesis_tpu.train.checkpoint import (
+    restore_checkpoint,
+    restore_opt_state,
+    save_checkpoint,
+)
+from masters_thesis_tpu.train.flatparams import (
+    FlatAdam,
+    flatten,
+    flatten_spec,
+    num_buffers,
+    replica_flat,
+    replica_opt_state,
+    stack_flat,
+    stack_opt_states,
+    unflatten,
+)
+from masters_thesis_tpu.train.steps import (
+    jit_cache_size,
+    make_stacked_train_epoch,
+    make_train_epoch,
+)
+
+LRS = (1e-2, 5e-3, 2e-2)
+SEEDS = (0, 1, 2)
+
+
+def small_spec(**kw) -> ModelSpec:
+    defaults = dict(
+        objective="mse", hidden_size=8, num_layers=2, dropout=0.0,
+        kernel_impl="xla",
+    )
+    defaults.update(kw)
+    return ModelSpec(**defaults)
+
+
+def init_params(spec: ModelSpec, module, seed: int):
+    return module.init(
+        jax.random.key(seed),
+        jnp.zeros((1, AUDIT_LOOKBACK, AUDIT_FEATURES), jnp.float32),
+    )["params"]
+
+
+def epoch_rng(seed: int, epoch: int):
+    return jax.random.fold_in(jax.random.key(100 + seed), epoch)
+
+
+def run_independent(spec, module, split, mesh, seed, lr, n_epochs, clip=0.5):
+    """One solo run through the single-replica flat epoch program."""
+    repl = replicated_sharding(mesh)
+    tx = FlatAdam(clip, spec.weight_decay)
+    params = init_params(spec, module, seed)
+    opt_state = global_put(tx.init(params), repl)
+    params = global_put(params, repl)
+    data = global_put(split, batch_sharding(mesh))
+    fn = make_train_epoch(
+        module, spec.window_objective(), spec.metric_keys, tx, mesh,
+        batch_size=AUDIT_BATCH,
+    )
+    lr_dev = global_put(jnp.float32(lr), repl)
+    sums_hist = []
+    for e in range(n_epochs):
+        rng = global_put(epoch_rng(seed, e), repl)
+        params, opt_state, sums = fn(params, opt_state, lr_dev, rng, data)
+        sums_hist.append(jax.device_get(sums))
+    return jax.device_get(params), jax.device_get(opt_state), sums_hist
+
+
+def run_stacked(
+    spec, module, split, mesh, seeds, lrs, n_epochs, clip=0.5
+):
+    """The same runs as a stack: one program, R replicas."""
+    repl = replicated_sharding(mesh)
+    tx = FlatAdam(clip, spec.weight_decay)
+    params_list = [init_params(spec, module, s) for s in seeds]
+    fspec = flatten_spec(params_list[0])
+    pstack = global_put(
+        stack_flat([flatten(p, fspec) for p in params_list]), repl
+    )
+    ostack = global_put(
+        stack_opt_states([tx.init(p) for p in params_list]), repl
+    )
+    data = global_put(split, batch_sharding(mesh))
+    fn = make_stacked_train_epoch(
+        module, spec.window_objective(), spec.metric_keys, tx, mesh, fspec,
+        batch_size=AUDIT_BATCH,
+    )
+    lrs_dev = global_put(jnp.asarray(lrs, jnp.float32), repl)
+    sums_hist = []
+    for e in range(n_epochs):
+        rngs = global_put(
+            jnp.stack([epoch_rng(s, e) for s in seeds]), repl
+        )
+        pstack, ostack, sums = fn(pstack, ostack, lrs_dev, rngs, data)
+        sums_hist.append(jax.device_get(sums))
+    assert jit_cache_size(fn) == 1
+    return jax.device_get(pstack), jax.device_get(ostack), sums_hist, fspec
+
+
+@pytest.fixture(scope="module")
+def stacked_setup():
+    assert len(jax.devices()) == 8  # conftest forces the virtual mesh
+    spec = small_spec()
+    mesh = make_data_mesh(None)
+    module = spec.build_module()
+    split = _synthetic_split(
+        mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0)
+    )
+    return spec, mesh, module, split
+
+
+class TestStackedVsIndependent:
+    """R=3 heterogeneous (lr, seed) stacked run vs 3 solo FlatAdam runs
+    over 2 epochs on the 8-device mesh."""
+
+    def test_two_epoch_parity(self, stacked_setup):
+        spec, mesh, module, split = stacked_setup
+        pstack, ostack, s_hist, fspec = run_stacked(
+            spec, module, split, mesh, SEEDS, LRS, n_epochs=2
+        )
+        for r, (seed, lr) in enumerate(zip(SEEDS, LRS)):
+            p_solo, o_solo, solo_hist = run_independent(
+                spec, module, split, mesh, seed, lr, n_epochs=2
+            )
+            solo_bufs = flatten(p_solo, fspec)
+            # Epoch-0 metric sums are bitwise per replica (identical
+            # starting params; the forward pass is reassociation-stable
+            # at these shapes). From epoch 1 on, the forward runs on
+            # ULP-drifted params, so sums get the same tight tolerance
+            # as the params themselves.
+            for e in range(2):
+                for k in solo_hist[e]:
+                    for solo_part, stacked_part in zip(
+                        solo_hist[e][k], s_hist[e][k]
+                    ):
+                        a = np.asarray(solo_part)
+                        b = np.asarray(stacked_part)[r]
+                        if e == 0:
+                            assert np.array_equal(a, b), (
+                                f"replica {r} epoch 0 metric {k}"
+                            )
+                        else:
+                            np.testing.assert_allclose(
+                                b, a, rtol=1e-5, atol=0,
+                                err_msg=f"replica {r} epoch {e} metric {k}",
+                            )
+            # Params/moments: tight tolerance, NOT bitwise — the batched
+            # gemm backward reassociates on XLA:CPU (module docstring).
+            for k, buf in solo_bufs.items():
+                a, b = np.asarray(buf), np.asarray(pstack[k][r])
+                np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-7)
+            assert int(ostack.count[r]) == int(o_solo.count)
+            for k in o_solo.mu:
+                np.testing.assert_allclose(
+                    np.asarray(ostack.mu[k][r]), np.asarray(o_solo.mu[k]),
+                    rtol=1e-3, atol=1e-7,
+                )
+
+    def test_heterogeneous_lrs_actually_differ(self, stacked_setup):
+        """Guard against a broadcast bug silently training every replica
+        at the same lr: rows of the stack must NOT match each other."""
+        spec, mesh, module, split = stacked_setup
+        pstack, _, _, _ = run_stacked(
+            spec, module, split, mesh, (0, 0, 0), LRS, n_epochs=1
+        )
+        for k, v in pstack.items():
+            assert not np.array_equal(v[0], v[1])
+            assert not np.array_equal(v[0], v[2])
+
+
+class TestReplicaIsolation:
+    """Row r of the stack depends on row r's (seed, lr) only: changing
+    replica 2's config must leave replicas 0 and 1 BIT-identical."""
+
+    def test_sibling_rows_bitwise_invariant(self, stacked_setup):
+        spec, mesh, module, split = stacked_setup
+        p_a, o_a, s_a, _ = run_stacked(
+            spec, module, split, mesh, SEEDS, LRS, n_epochs=2
+        )
+        p_b, o_b, s_b, _ = run_stacked(
+            spec, module, split, mesh, (SEEDS[0], SEEDS[1], 7),
+            (LRS[0], LRS[1], 4e-2), n_epochs=2,
+        )
+        for r in (0, 1):
+            for k in p_a:
+                assert np.array_equal(p_a[k][r], p_b[k][r])
+                assert np.array_equal(o_a.mu[k][r], o_b.mu[k][r])
+                assert np.array_equal(o_a.nu[k][r], o_b.nu[k][r])
+            for e in range(2):
+                for k in s_a[e]:
+                    for part_a, part_b in zip(s_a[e][k], s_b[e][k]):
+                        assert np.array_equal(
+                            np.asarray(part_a)[r], np.asarray(part_b)[r]
+                        )
+        # ... and replica 2 did change (the perturbation reached it).
+        assert any(
+            not np.array_equal(p_a[k][2], p_b[k][2]) for k in p_a
+        )
+
+
+class TestOptimizerLayerBitParity:
+    """The vmapped FlatAdam fold (clip-norm included) and the per-replica
+    RNG derivations are bitwise identical to their per-lane equivalents —
+    the layers the stacked path adds on top of the (already bit-pinned)
+    single-replica flat path."""
+
+    def test_vmapped_update_flat_bitwise(self, stacked_setup):
+        spec, _, module, _ = stacked_setup
+        tx = FlatAdam(0.5, spec.weight_decay)  # clip ON: exercises the
+        # _leaf_square_sum reduction under vmap
+        params_list = [init_params(spec, module, s) for s in SEEDS]
+        fspec = flatten_spec(params_list[0])
+        pstack = stack_flat([flatten(p, fspec) for p in params_list])
+        ostack = stack_opt_states([tx.init(p) for p in params_list])
+        rng = np.random.default_rng(3)
+        gstack = {
+            k: jnp.asarray(
+                rng.standard_normal(v.shape).astype(v.dtype) * 0.1
+            )
+            for k, v in pstack.items()
+        }
+        lrs = jnp.asarray(LRS, jnp.float32)
+
+        def one(g, o, p, lr):
+            u, o2 = tx.update_flat(g, o, p, fspec)
+            p2 = {k: p[k] - lr * u[k].astype(p[k].dtype) for k in p}
+            return p2, o2
+
+        p_v, o_v = jax.vmap(one)(gstack, ostack, pstack, lrs)
+        for r in range(len(SEEDS)):
+            p_s, o_s = one(
+                replica_flat(gstack, r),
+                replica_opt_state(ostack, r),
+                replica_flat(pstack, r),
+                lrs[r],
+            )
+            for k in p_s:
+                assert np.array_equal(np.asarray(p_v[k][r]), np.asarray(p_s[k]))
+                assert np.array_equal(
+                    np.asarray(o_v.mu[k][r]), np.asarray(o_s.mu[k])
+                )
+                assert np.array_equal(
+                    np.asarray(o_v.nu[k][r]), np.asarray(o_s.nu[k])
+                )
+            assert int(o_v.count[r]) == int(o_s.count)
+
+    def test_vmapped_rng_streams_bitwise(self):
+        keys = jnp.stack([jax.random.key(s) for s in SEEDS])
+
+        def derive(key):
+            key = jax.random.fold_in(key, 3)
+            a, b = jax.random.split(key)
+            return jax.random.permutation(a, 16), jax.random.uniform(b, (4,))
+
+        perm_v, u_v = jax.vmap(derive)(keys)
+        for r, s in enumerate(SEEDS):
+            perm_s, u_s = derive(jax.random.key(s))
+            assert np.array_equal(np.asarray(perm_v[r]), np.asarray(perm_s))
+            assert np.array_equal(np.asarray(u_v[r]), np.asarray(u_s))
+
+
+class TestStackedCollectives:
+    """TA207: the stacked program carries ONE batched all-reduce per dtype
+    buffer per step — independent of R — and compiles exactly once."""
+
+    @pytest.mark.parametrize("R", [1, 3])
+    def test_one_batched_collective_per_buffer(self, stacked_setup, R):
+        spec, mesh, module, split = stacked_setup
+        repl = replicated_sharding(mesh)
+        tx = FlatAdam(0.5, spec.weight_decay)
+        params_list = [init_params(spec, module, s) for s in range(R)]
+        fspec = flatten_spec(params_list[0])
+        pstack = global_put(
+            stack_flat([flatten(p, fspec) for p in params_list]), repl
+        )
+        ostack = global_put(
+            stack_opt_states([tx.init(p) for p in params_list]), repl
+        )
+        data = global_put(split, batch_sharding(mesh))
+        fn = make_stacked_train_epoch(
+            module, spec.window_objective(), spec.metric_keys, tx, mesh,
+            fspec, batch_size=AUDIT_BATCH,
+        )
+        lowered = fn.lower(
+            pstack, ostack,
+            global_put(jnp.ones((R,), jnp.float32) * 1e-2, repl),
+            global_put(
+                jnp.stack([jax.random.key(s) for s in range(R)]), repl
+            ),
+            data,
+        )
+        n = count_step_collectives(lowered.compile().as_text())
+        assert n == num_buffers(fspec) == 1
+
+    def test_stacked_trace_audit_clean(self, stacked_setup):
+        _, mesh, _, _ = stacked_setup
+        assert run_stacked_trace_audit(mesh=mesh, replicas=3, steps=2) == []
+
+    def test_requires_flat_adam(self, stacked_setup):
+        spec, mesh, module, _ = stacked_setup
+        from masters_thesis_tpu.train.optim import make_optimizer
+
+        with pytest.raises(TypeError, match="FlatAdam"):
+            make_stacked_train_epoch(
+                module, spec.window_objective(), spec.metric_keys,
+                make_optimizer(0.5, spec.weight_decay), mesh,
+                flatten_spec(init_params(spec, module, 0)),
+                batch_size=AUDIT_BATCH,
+            )
+
+
+class TestStackedCheckpointRoundtrip:
+    """A replica extracted from the stack round-trips through the
+    (unflattened, params-shaped) checkpoint layout bitwise, and re-stacks
+    into the same rows — the resume path StackedTrainer uses."""
+
+    def test_replica_opt_state_roundtrip_bitwise(self, stacked_setup):
+        spec, _, module, _ = stacked_setup
+        tx = FlatAdam(0.5, spec.weight_decay)
+        params_list = [init_params(spec, module, s) for s in SEEDS]
+        fspec = flatten_spec(params_list[0])
+        pstack = stack_flat([flatten(p, fspec) for p in params_list])
+        ostack = stack_opt_states([tx.init(p) for p in params_list])
+        # Take one real optimizer step so the moments are non-trivial.
+        gstack = {k: jnp.full_like(v, 0.25) for k, v in pstack.items()}
+
+        def one(g, o, p):
+            u, o2 = tx.update_flat(g, o, p, fspec)
+            return {k: p[k] - 1e-2 * u[k] for k in p}, o2
+
+        pstack, ostack = jax.vmap(one)(gstack, ostack, pstack)
+
+        r = 1
+        params_r = unflatten(replica_flat(pstack, r), fspec)
+        opt_r = replica_opt_state(ostack, r)
+        with tempfile.TemporaryDirectory() as d:
+            save_checkpoint(
+                Path(d), "last", params_r, opt_r, spec, {"epoch": 0}
+            )
+            got_params, got_opt, _, _ = restore_checkpoint(Path(d), "last")
+            template = jax.device_get(tx.init(params_list[r]))
+            restored = restore_opt_state(
+                template, got_opt, params=got_params
+            )
+        back_p = flatten(
+            jax.tree_util.tree_map(jnp.asarray, got_params), fspec
+        )
+        for k in pstack:
+            assert np.array_equal(np.asarray(back_p[k]), np.asarray(pstack[k][r]))
+            assert np.array_equal(
+                np.asarray(restored.mu[k]), np.asarray(ostack.mu[k][r])
+            )
+            assert np.array_equal(
+                np.asarray(restored.nu[k]), np.asarray(ostack.nu[k][r])
+            )
+        assert int(restored.count) == int(ostack.count[r])
+        # Re-stacking the restored replica reproduces the original rows.
+        restacked = stack_opt_states(
+            [replica_opt_state(ostack, 0), restored, replica_opt_state(ostack, 2)]
+        )
+        for k in ostack.mu:
+            assert np.array_equal(
+                np.asarray(restacked.mu[k]), np.asarray(ostack.mu[k])
+            )
+
+
+@pytest.fixture(scope="module")
+def tiny_dm(tmp_path_factory) -> FinancialWindowDataModule:
+    data_dir = tmp_path_factory.mktemp("stacked_data")
+    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+        n_stocks=8, n_samples=4000, seed=1
+    )
+    np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+    np.save(data_dir / "market.npy", np.asarray(r_market))
+    np.save(data_dir / "alphas.npy", np.asarray(alphas))
+    np.save(data_dir / "betas.npy", np.asarray(betas))
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24, batch_size=2
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    return dm
+
+
+REPLICAS = [
+    ReplicaSpec("a", 0, 1e-2),
+    ReplicaSpec("b", 1, 5e-3),
+    ReplicaSpec("c", 2, 2e-2),
+]
+
+
+def fit_spec():
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        learning_rate=1e-2,
+    )
+
+
+class TestStackedTrainer:
+    """End-to-end driver: divergence isolation, per-replica checkpoints,
+    resume contract."""
+
+    @pytest.fixture(scope="class")
+    def clean_run(self, tiny_dm, tmp_path_factory):
+        ckpt = tmp_path_factory.mktemp("stacked_ckpt")
+        trainer = StackedTrainer(
+            max_epochs=3, gradient_clip_val=5.0,
+            enable_progress_bar=False, ckpt_dir=ckpt,
+        )
+        return trainer.fit(fit_spec(), tiny_dm, REPLICAS), ckpt
+
+    def test_all_replicas_train(self, clean_run):
+        result, _ = clean_run
+        assert [r.status for r in result.replicas] == ["active"] * 3
+        for rep in result.replicas:
+            losses = [h["loss/total/train"] for h in rep.history]
+            assert all(np.isfinite(v) for v in losses)
+            assert losses[-1] < losses[0]
+            assert np.isfinite(rep.best_val_loss)
+        # Heterogeneous lrs -> distinct trajectories.
+        assert len({r.history[-1]["loss/total/train"]
+                    for r in result.replicas}) == 3
+        assert result.replica_steps_per_sec == pytest.approx(
+            3 * result.steps_per_sec
+        )
+
+    def test_per_replica_checkpoints_and_resume(self, clean_run, tiny_dm):
+        result, ckpt = clean_run
+        for rep in REPLICAS:
+            got_params, _, _, meta = restore_checkpoint(ckpt / rep.name, "last")
+            assert meta["replica"]["name"] == rep.name
+            assert meta["replica"]["seed"] == rep.seed
+            assert meta["trainer"] == "stacked"
+            assert meta["epoch"] == 2
+        # Resume trains only the remaining epochs, for every replica.
+        trainer = StackedTrainer(
+            max_epochs=5, gradient_clip_val=5.0,
+            enable_progress_bar=False, ckpt_dir=ckpt, resume=True,
+        )
+        resumed = trainer.fit(fit_spec(), tiny_dm, REPLICAS)
+        assert resumed.epochs == 2
+        assert all(len(r.history) == 2 for r in resumed.replicas)
+        assert all(h["epoch"] == e for r in resumed.replicas
+                   for e, h in zip((3, 4), r.history))
+
+    def test_divergence_masks_one_replica_siblings_bitwise(
+        self, clean_run, tiny_dm
+    ):
+        """Poison replica 1's loss readback twice: it must roll back, then
+        mask — while replicas 0 and 2 finish BIT-identical to the clean
+        run and the run as a whole keeps going."""
+        clean, _ = clean_run
+        plan = faults.FaultPlan(faults=[
+            faults.FaultSpec(
+                point="stacked.replica_loss", kind="nan", attempt=None,
+                match={"replica": 1, "epoch": 1},
+            ),
+            faults.FaultSpec(
+                point="stacked.replica_loss", kind="nan", attempt=None,
+                match={"replica": 1, "epoch": 2},
+            ),
+        ])
+        faults.install_plan(plan)
+        try:
+            trainer = StackedTrainer(
+                max_epochs=3, gradient_clip_val=5.0,
+                enable_progress_bar=False,
+            )
+            faulty = trainer.fit(fit_spec(), tiny_dm, REPLICAS)
+        finally:
+            faults.clear_plan()
+        assert faulty.replicas[1].status == "masked"
+        assert faulty.replicas[1].rollbacks == 2
+        assert [faulty.replicas[r].status for r in (0, 2)] == ["active"] * 2
+        for r in (0, 2):
+            a = jax.tree_util.tree_leaves(clean.replicas[r].params)
+            b = jax.tree_util.tree_leaves(faulty.replicas[r].params)
+            assert all(np.array_equal(x, y) for x, y in zip(a, b))
+            assert faulty.replicas[r].rollbacks == 0
+
+    def test_single_fault_recovers(self, tiny_dm):
+        """One transient NaN: roll back, halve lr, resume training —
+        status returns to active and the final loss is finite."""
+        plan = faults.FaultPlan(faults=[
+            faults.FaultSpec(
+                point="stacked.replica_loss", kind="nan", attempt=None,
+                match={"replica": 0, "epoch": 1},
+            ),
+        ])
+        faults.install_plan(plan)
+        try:
+            trainer = StackedTrainer(
+                max_epochs=3, gradient_clip_val=5.0,
+                enable_progress_bar=False,
+            )
+            result = trainer.fit(fit_spec(), tiny_dm, REPLICAS)
+        finally:
+            faults.clear_plan()
+        rep = result.replicas[0]
+        assert rep.status == "active"
+        assert rep.rollbacks == 1
+        assert np.isfinite(rep.history[-1]["loss/total/train"])
+        # The recovery halved the lr from its configured value.
+        assert rep.history[-1]["lr-Adam"] == pytest.approx(
+            REPLICAS[0].learning_rate / 2
+        )

@@ -19,7 +19,8 @@ fi
 # 2. tracelint: AST lint over the package + trace-time audit on the
 #    hermetic 8-device virtual CPU mesh (includes TA206: the compiled
 #    train step carries exactly ONE cross-replica reduction — the flat
-#    gradient pmean).
+#    gradient pmean — and TA207: the stacked R-replica program compiles
+#    once with the same single batched all-reduce per dtype buffer).
 echo "== tracelint =="
 JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
 
