@@ -689,9 +689,47 @@ def _serve_bench() -> int:
                 "deadline_ms": round(deadline_s * 1e3, 1),
                 "buckets": list(SERVE_BUCKETS),
                 "compile_events": engine.compile_events,
+                # Latency attribution from the per-request spans: where a
+                # completed request's wall actually went, and why sheds
+                # happened (categories from serve/server.py shed_category).
+                "queue_wait_share": (
+                    None if stats["queue_wait_share"] is None
+                    else round(stats["queue_wait_share"], 4)
+                ),
+                "compute_share": (
+                    None if stats["compute_share"] is None
+                    else round(stats["compute_share"], 4)
+                ),
+                "shed_by_reason": stats["shed_by_reason"],
             },
         },
     }
+    try:
+        from masters_thesis_tpu.telemetry.ledger import (
+            DEFAULT_LEDGER_PATH,
+            append_record,
+            ledger_record,
+        )
+
+        path = Path(__file__).resolve().parent / DEFAULT_LEDGER_PATH
+        round_id = os.environ.get("MTT_BENCH_ROUND") or time.strftime(
+            "%Y%m%dT%H%M%S"
+        )
+        append_record(path, ledger_record(
+            point="serve/p99",
+            round_id=round_id,
+            platform=engine.platform,
+            steps_per_sec=None,
+            objective="mse",
+            p99_latency_ms=p99,
+            p50_latency_ms=stats["p50_ms"],
+            qps=stats["qps"],
+            shed=stats["shed"],
+            queue_wait_share=stats["queue_wait_share"],
+            compute_share=stats["compute_share"],
+        ))
+    except Exception as exc:  # noqa: BLE001 — observability, not the bench
+        print(f"perf ledger append failed: {exc!r}", file=sys.stderr)
     print(json.dumps(result))
     if late:
         print(

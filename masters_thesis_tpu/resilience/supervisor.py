@@ -49,6 +49,11 @@ from pathlib import Path
 from typing import Sequence
 
 from masters_thesis_tpu.resilience.faults import ATTEMPT_ENV
+from masters_thesis_tpu.telemetry.trace import (
+    PARENT_SPAN_ENV,
+    TRACE_ENV,
+    new_trace_id,
+)
 
 LR_SCALE_ENV = "MTT_LR_SCALE"
 TERM_GRACE_S = 15.0
@@ -175,6 +180,15 @@ class RunSupervisor:
         self.passthrough = passthrough
         self._tel = None
         self._degraded = False
+        # One stable trace id for the WHOLE supervised run: adopted from
+        # the caller's env when present (a grid runner tracing the cell),
+        # minted once otherwise — and propagated FORWARD to every attempt
+        # via the env, so retries and rollbacks share the trace instead of
+        # being stitched together after the fact.
+        self.trace_id = self.base_env.get(TRACE_ENV) or new_trace_id()
+        self.base_env[TRACE_ENV] = self.trace_id
+        self._trace = None
+        self._run_span = None
 
     # ------------------------------------------------------------ telemetry
 
@@ -193,6 +207,22 @@ class RunSupervisor:
         except Exception:
             # The supervisor's own telemetry must never kill supervision.
             pass
+
+    def _tracer(self):
+        """Span writer on the supervisor's own stream, pinned to the
+        run's stable trace id (the supervisor's process env may not carry
+        it — it lives in base_env for the children)."""
+        if self._trace is None:
+            try:
+                from masters_thesis_tpu.telemetry.trace import Tracer
+
+                tel = self._telemetry()
+                self._trace = Tracer(tel.sink, trace_id=self.trace_id)
+                # Share with the TelemetryRun so close() aborts leftovers.
+                tel._tracer = self._trace
+            except Exception:
+                return None
+        return self._trace
 
     # ------------------------------------------------------------- evidence
 
@@ -335,6 +365,16 @@ class RunSupervisor:
         deadline = (
             t0 + cfg.attempt_timeout_s if cfg.attempt_timeout_s else None
         )
+        tracer = self._tracer()
+        attempt_span = None
+        if tracer is not None:
+            attempt_span = tracer.start(
+                "supervisor.attempt", parent=self._run_span, n=attempt,
+                lr_scale=lr_scale, resumed=bool(resumed_from),
+            )
+            # The child's root spans hang off this attempt span — one
+            # trace covers the supervisor and every process it launches.
+            env[PARENT_SPAN_ENV] = attempt_span.span_id
         self._event(
             "attempt_started",
             n=attempt,
@@ -342,6 +382,7 @@ class RunSupervisor:
             resumed_from=resumed_from,
             lr_scale=lr_scale,
             degraded=self._degraded,
+            trace_id=self.trace_id,
         )
 
         with open(out_path, "wb") as out_f, open(err_path, "wb") as err_f:
@@ -426,6 +467,13 @@ class RunSupervisor:
             lost_work_s=lost,
             hang_killed=hang_killed,
         )
+        if tracer is not None and attempt_span is not None:
+            tracer.end(
+                attempt_span,
+                status="ok" if classification.kind == "success" else "error",
+                rc=rc,
+                classification=classification.kind,
+            )
         self._event(
             "attempt_finished",
             n=attempt,
@@ -519,6 +567,9 @@ class RunSupervisor:
     def run(self) -> SupervisorResult:
         cfg = self.cfg
         result = SupervisorResult(ok=False, verdict="retries_exhausted")
+        tracer = self._tracer()
+        if tracer is not None:
+            self._run_span = tracer.start("supervisor.run")
         self._event(
             "supervisor_started",
             cmd=shlex.join(self.cmd),
@@ -527,6 +578,7 @@ class RunSupervisor:
             lr_factor=cfg.lr_factor,
             retry_budget_s=cfg.retry_budget_s,
             probe=cfg.probe,
+            trace_id=self.trace_id,
         )
         t_start = time.monotonic()
         attempt = 0
@@ -621,6 +673,14 @@ class RunSupervisor:
             time.sleep(backoff)
             backoff = min(backoff * cfg.backoff_factor, cfg.max_backoff_s)
 
+        if tracer is not None and self._run_span is not None:
+            tracer.end(
+                self._run_span,
+                status="ok" if result.ok else "error",
+                verdict=result.verdict,
+                attempts=result.n_attempts,
+            )
+            self._run_span = None
         self._event(
             "supervisor_verdict",
             ok=result.ok,
@@ -629,6 +689,7 @@ class RunSupervisor:
             restarts=max(0, result.n_attempts - 1),
             lost_work_s=result.lost_work_s,
             degraded=self._degraded,
+            trace_id=self.trace_id,
         )
         result.degraded = self._degraded
         if self._tel is not None:

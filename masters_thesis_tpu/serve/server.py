@@ -52,6 +52,20 @@ class InjectedDeviceError(RuntimeError):
     """Stand-in for a device/runtime failure (serve.dispatch wedge)."""
 
 
+def shed_category(reason: str) -> str:
+    """Collapse the queue's free-text shed reasons into stable categories
+    (the trace CLI and bench report break sheds down by these)."""
+    if reason.startswith("server shutting down"):
+        return "shutdown"
+    if reason.startswith("injected admission shed"):
+        return "fault"
+    if reason.startswith("queue full"):
+        return "queue_full"
+    if reason.startswith("deadline infeasible"):
+        return "deadline_infeasible"
+    return "other"
+
+
 class PredictServer:
     """Owns the queue, the dispatch thread, and the degradation policy."""
 
@@ -95,6 +109,17 @@ class PredictServer:
         #: anything else is a bug and fails the serve bench.
         self.late_deliveries = 0
         self.degradations = 0
+        self.shed_by_reason: dict[str, int] = {}
+        # Per-request trace state: rid -> {span, boundary stamps}. The
+        # boundaries (submit, admitted, batch pickup, predict start/end,
+        # resolve) tile each request's wall exactly, so the trace CLI's
+        # critical-path components sum to measured latency by construction.
+        self._serve_span = None
+        self._req_trace: dict[int, dict] = {}
+        self._trace_lock = threading.Lock()
+        self._sum_queue_s = 0.0
+        self._sum_device_s = 0.0
+        self._sum_req_wall_s = 0.0
 
     # ------------------------------------------------------------ telemetry
 
@@ -110,6 +135,50 @@ class PredictServer:
         if self.telemetry is not None:
             self.telemetry.histogram("serve/latency_s").observe(latency_s)
 
+    def _tracer(self):
+        return self.telemetry.tracer if self.telemetry is not None else None
+
+    def _close_request_span(self, pending, status: str, t_resolve: float,
+                            **attrs) -> None:
+        """End a request span with components that tile its wall exactly.
+
+        Missing boundaries (e.g. a pre-dispatch rejection never reaches the
+        engine) collapse to zero-width components; boundaries are forced
+        monotone so a submit/pickup stamp race can't produce negatives.
+        """
+        tracer = self._tracer()
+        if tracer is None:
+            return
+        with self._trace_lock:
+            entry = self._req_trace.pop(pending.request.rid, None)
+        if entry is None:
+            return
+        b = [entry["t0"]]
+        for key in ("t_admitted", "t_pickup", "t_predict0", "t_predict_end"):
+            t = entry.get(key)
+            b.append(b[-1] if t is None else max(b[-1], t))
+        b.append(max(b[-1], t_resolve))
+        admit_s, queue_s, batch_form_s, device_s, deliver_s = (
+            b[i + 1] - b[i] for i in range(5)
+        )
+        wall = b[-1] - b[0]
+        if status == "ok":
+            with self._trace_lock:
+                self._sum_queue_s += queue_s
+                self._sum_device_s += device_s
+                self._sum_req_wall_s += wall
+        tracer.end(
+            entry["span"],
+            status=status,
+            dur_s=wall,
+            admit_s=admit_s,
+            queue_s=queue_s,
+            batch_form_s=batch_form_s,
+            device_s=device_s,
+            deliver_s=deliver_s,
+            **attrs,
+        )
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
@@ -118,6 +187,13 @@ class PredictServer:
         warm_s = self.engine.warmup()
         self.service_model.seed(warm_s)
         self._started_ts = time.monotonic()
+        tracer = self._tracer()
+        if tracer is not None:
+            self._serve_span = tracer.start(
+                "serve.server",
+                platform=self.engine.platform,
+                max_batch=self.max_batch,
+            )
         self._event(
             "serve_started",
             platform=self.engine.platform,
@@ -156,6 +232,16 @@ class PredictServer:
             self._thread = None
         self._stop.set()
         stats = self.stats()
+        tracer = self._tracer()
+        if tracer is not None and self._serve_span is not None:
+            tracer.end(
+                self._serve_span,
+                status="ok",
+                requests=stats["requests"],
+                completed=stats["completed"],
+                shed=stats["shed"],
+            )
+            self._serve_span = None
         self._event("serve_finished", **stats)
         return stats
 
@@ -169,7 +255,19 @@ class PredictServer:
         if self.telemetry is not None:
             hist = self.telemetry.histogram("serve/latency_s")
             p50, p99 = hist.quantile(0.5), hist.quantile(0.99)
+        with self._trace_lock:
+            wall_sum = self._sum_req_wall_s
+            queue_wait_share = (
+                self._sum_queue_s / wall_sum if wall_sum > 0 else None
+            )
+            compute_share = (
+                self._sum_device_s / wall_sum if wall_sum > 0 else None
+            )
+            shed_by_reason = dict(self.shed_by_reason)
         return {
+            "queue_wait_share": queue_wait_share,
+            "compute_share": compute_share,
+            "shed_by_reason": shed_by_reason,
             "requests": self.queue.submitted,
             "completed": self.completed,
             "shed": self.queue.shed,
@@ -197,15 +295,52 @@ class PredictServer:
             self._rid += 1
             rid = self._rid
         self._count("requests")
-        return self.queue.submit(
+        tracer = self._tracer()
+        if tracer is not None:
+            # The span must exist BEFORE queue.submit: a shed resolves
+            # synchronously inside it, and _on_shed closes the span.
+            entry = {
+                "span": tracer.start(
+                    "serve.request",
+                    parent=self._serve_span,
+                    rid=rid,
+                    deadline_ms=deadline_s * 1e3,
+                ),
+                "t0": time.perf_counter(),
+            }
+            with self._trace_lock:
+                self._req_trace[rid] = entry
+        pending = self.queue.submit(
             ServeRequest(
                 rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s
             )
         )
+        if tracer is not None and not pending.done:
+            with self._trace_lock:
+                live = self._req_trace.get(rid)
+                if live is not None:
+                    live["t_admitted"] = time.perf_counter()
+        return pending
 
     def _on_shed(self, request: ServeRequest, reason: str) -> None:
         self._count("shed")
+        category = shed_category(reason)
+        with self._trace_lock:
+            self.shed_by_reason[category] = (
+                self.shed_by_reason.get(category, 0) + 1
+            )
         self._event("request_shed", rid=request.rid, reason=reason)
+        tracer = self._tracer()
+        if tracer is not None:
+            with self._trace_lock:
+                entry = self._req_trace.pop(request.rid, None)
+            if entry is not None:
+                tracer.end(
+                    entry["span"],
+                    status="shed",
+                    reason_category=category,
+                    admit_s=time.perf_counter() - entry["t0"],
+                )
 
     # ------------------------------------------------------------- dispatch
 
@@ -216,11 +351,19 @@ class PredictServer:
                 if self.queue.closed and len(self.queue) == 0:
                     return
                 continue
+            if self._tracer() is not None:
+                t_pickup = time.perf_counter()
+                with self._trace_lock:
+                    for p in batch:
+                        entry = self._req_trace.get(p.request.rid)
+                        if entry is not None:
+                            entry["t_pickup"] = t_pickup
             self._dispatch(batch)
 
     def _resolve(self, pending: PendingRequest, status: str, detail: str = "",
                  outputs: tuple | None = None) -> None:
         now = time.monotonic()
+        t_resolve = time.perf_counter()
         pending.resolve(
             ServeResponse(
                 rid=pending.request.rid,
@@ -231,6 +374,7 @@ class PredictServer:
                 latency_s=now - pending.request.submitted_ts,
             )
         )
+        self._close_request_span(pending, status, t_resolve)
 
     def _dispatch(self, batch: list[PendingRequest]) -> None:
         # Pre-dispatch feasibility re-check: queue wait may have eaten a
@@ -255,7 +399,20 @@ class PredictServer:
         seq = self._dispatch_seq
         self._dispatch_seq += 1
         kind = faults.fire("serve.dispatch", seq=seq, n=len(live))
+        tracer = self._tracer()
+        t0_wall = time.time()
         t0 = time.perf_counter()
+
+        def stamp(key: str, t: float) -> None:
+            if tracer is None:
+                return
+            with self._trace_lock:
+                for p in live:
+                    entry = self._req_trace.get(p.request.rid)
+                    if entry is not None:
+                        entry[key] = t
+
+        stamp("t_predict0", t0)
         try:
             if kind == "wedge":
                 raise InjectedDeviceError(
@@ -266,6 +423,7 @@ class PredictServer:
             if kind == "nan":
                 alpha = np.full_like(alpha, np.nan)
         except Exception as exc:  # noqa: BLE001 — any dispatch failure
+            stamp("t_predict_end", time.perf_counter())
             self.errors += len(live)
             self._count("errors", len(live))
             for p in live:
@@ -275,7 +433,18 @@ class PredictServer:
             if self.breaker.record_failure():
                 self._degrade(exc)
             return
-        self.service_model.update(time.perf_counter() - t0)
+        device_s = time.perf_counter() - t0
+        stamp("t_predict_end", t0 + device_s)
+        if tracer is not None:
+            tracer.emit_span(
+                "serve.device",
+                start_ts=t0_wall,
+                dur_s=device_s,
+                parent=self._serve_span,
+                seq=seq,
+                n=len(live),
+            )
+        self.service_model.update(device_s)
         self.breaker.record_success()
         finite = bool(
             np.isfinite(alpha).all() and np.isfinite(beta).all()

@@ -25,6 +25,11 @@ a program SHOULD do; this package measures what runs actually DO:
   pure math importable without jax, extraction lazy;
 - :mod:`ledger`   — append-only ``results/perf_ledger.jsonl`` of measured
   bench points (stdlib-only) + round-over-round regression diffing;
+- :mod:`trace`    — distributed tracing: close-only spans on the event
+  stream, ``MTT_TRACE_ID``/``MTT_PARENT_SPAN`` env propagation across
+  supervisor attempts / grid cells / fleet workers, open-span flushing
+  through the flight recorder, and the Perfetto export + critical-path
+  attribution behind the ``trace`` CLI;
 - :mod:`report` + ``__main__`` — ``python -m masters_thesis_tpu.telemetry
   summarize|aggregate|postmortem|ledger <run>``: single-run reports, fleet
   postmortems, and perf-ledger diffs; exit nonzero on contract violations
@@ -65,8 +70,26 @@ from masters_thesis_tpu.telemetry.run import (
     TelemetryRun,
     device_memory_snapshot,
 )
+from masters_thesis_tpu.telemetry.trace import (
+    PARENT_SPAN_ENV,
+    TRACE_ENV,
+    Span,
+    Tracer,
+    build_trace_report,
+    child_env,
+    current_trace_id,
+    new_trace_id,
+)
 
 __all__ = [
+    "PARENT_SPAN_ENV",
+    "Span",
+    "TRACE_ENV",
+    "Tracer",
+    "build_trace_report",
+    "child_env",
+    "current_trace_id",
+    "new_trace_id",
     "CompileTracker",
     "CostModel",
     "Counter",

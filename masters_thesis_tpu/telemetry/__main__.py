@@ -21,6 +21,15 @@ Subcommands:
   1 = could not load, 2 = >threshold steps/s or utilization regression.
   ``--selfcheck`` fabricates a two-round ledger and verifies the gate
   fires (the tools/check.sh gate).
+- ``trace <run>`` — merge every process's ``span`` events (plus open
+  spans recovered from heartbeat/crashdump sidecars of killed processes)
+  into one validated span forest: critical-path attribution for the
+  p50/p99 serve request and the median epoch, and a Chrome-trace-event
+  JSON (``--out``, default ``<run>/trace.json``) viewable in Perfetto.
+  Exit codes: 0 = ok, 1 = no spans found, 2 = broken span tree (orphans,
+  negative durations, spans left open by a cleanly closed process).
+  ``--selfcheck`` runs the hermetic synthetic-fleet fixture instead (the
+  tools/check.sh gate).
 - ``selfcheck`` — hermetic smoke of the whole pipeline (registry ->
   events -> report) in a temp dir; the tools/check.sh telemetry gate.
 
@@ -271,6 +280,33 @@ def _ledger_selfcheck() -> int:
     return 0
 
 
+def _trace(args) -> int:
+    from masters_thesis_tpu.telemetry import trace
+
+    if args.selfcheck:
+        return trace.selfcheck()
+    if args.run is None:
+        print("trace: a run root is required (or --selfcheck)",
+              file=sys.stderr)
+        return 1
+    from pathlib import Path
+
+    root = Path(args.run)
+    if not root.exists():
+        print(f"trace: {root} does not exist", file=sys.stderr)
+        return 1
+    out = args.out
+    if out is None:
+        out = (root.parent if root.is_file() else root) / "trace.json"
+    report = trace.build_trace_report(root, out=out)
+    print(
+        json.dumps(report, indent=2, default=str)
+        if args.json
+        else trace.render_trace_text(report)
+    )
+    return report["exit_code"]
+
+
 def _selfcheck(args) -> int:
     from masters_thesis_tpu.telemetry.report import summarize_path
     from masters_thesis_tpu.telemetry.run import TelemetryRun
@@ -382,6 +418,27 @@ def main(argv: list[str] | None = None) -> int:
         help="hermetic two-round gate smoke instead of reading a ledger",
     )
     p_led.set_defaults(fn=_ledger)
+    p_trace = sub.add_parser(
+        "trace",
+        help="merged span timeline + critical-path attribution; exit 2 "
+             "on a broken span tree",
+    )
+    p_trace.add_argument(
+        "run", nargs="?", default=None,
+        help="run root (every events.jsonl under it joins the trace)",
+    )
+    p_trace.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p_trace.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="Chrome-trace JSON output (default <run>/trace.json)",
+    )
+    p_trace.add_argument(
+        "--selfcheck", action="store_true",
+        help="hermetic synthetic-fleet span fixture instead of a run",
+    )
+    p_trace.set_defaults(fn=_trace)
     p_check = sub.add_parser(
         "selfcheck", help="hermetic registry->events->report smoke"
     )

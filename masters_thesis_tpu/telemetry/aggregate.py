@@ -115,6 +115,23 @@ def digest_stream(path: Path, root: Path) -> dict:
     for e in epochs:
         if e.get("epoch") is not None and e.get("wall_s") is not None:
             epoch_walls[int(e["epoch"])] = float(e["wall_s"])
+    # Trace spans: the trace id the stream rides (one id spans the whole
+    # supervised fleet when propagation worked) and per-(span name, epoch)
+    # walls, so the fleet report can attribute collective wait to NAMED
+    # phases instead of only the epoch total.
+    spans = by_kind.get("span", [])
+    trace_id = next(
+        (s["trace_id"] for s in spans if s.get("trace_id")),
+        next((s["trace_id"] for s in starts if s.get("trace_id")), None),
+    )
+    span_walls: dict[str, dict[int, float]] = {}
+    for s in spans:
+        epoch = (s.get("attrs") or {}).get("epoch")
+        if epoch is None or s.get("dur_s") is None or not s.get("name"):
+            continue
+        span_walls.setdefault(str(s["name"]), {})[int(epoch)] = float(
+            s["dur_s"]
+        )
     crash_events = by_kind.get("crashdump", [])
     crashdump = _read_json(path.parent / CRASHDUMP_FILENAME)
     if crashdump is None and crash_events:
@@ -152,6 +169,8 @@ def digest_stream(path: Path, root: Path) -> dict:
         "epochs": len(epoch_walls),
         "last_epoch": max(epoch_walls) if epoch_walls else None,
         "epoch_walls": epoch_walls,
+        "trace_id": trace_id,
+        "span_walls": span_walls,
         "first_ts": events[0].get("ts") if events else None,
         "last_ts": events[-1].get("ts") if events else None,
         "crashdump": None if crashdump is None else {
@@ -249,6 +268,36 @@ def aggregate_streams(
         for d in digests
         if d["epoch_walls"]
     }
+
+    # Named-span wait attribution: the same (fleet max − own) fold, but per
+    # span name over the epochs every emitting stream shares — so "p1 waits
+    # 2s" decomposes into WHICH phase the fleet serializes on.
+    span_names = sorted(
+        {n for d in digests for n in (d.get("span_walls") or {})}
+    )
+    collective_wait_by_span: dict[str, dict[str, float]] = {}
+    for name in span_names:
+        swalls = [
+            d["span_walls"][name]
+            for d in digests
+            if (d.get("span_walls") or {}).get(name)
+        ]
+        if len(swalls) < 2:
+            continue
+        shared_e = set.intersection(*map(set, swalls))
+        if not shared_e:
+            continue
+        collective_wait_by_span[name] = {
+            d["label"]: sum(
+                max(w[e] for w in swalls) - d["span_walls"][name][e]
+                for e in shared_e
+            )
+            for d in digests
+            if (d.get("span_walls") or {}).get(name)
+        }
+    trace_ids = sorted(
+        {d["trace_id"] for d in digests if d.get("trace_id")}
+    )
 
     straggler = None
     if shared:
@@ -390,6 +439,8 @@ def aggregate_streams(
             h: sum(v) / len(v) for h, v in sorted(per_host_wall.items())
         },
         "collective_wait_s": collective_wait,
+        "collective_wait_by_span_s": collective_wait_by_span,
+        "trace_ids": trace_ids,
         "utilization": fleet_util,
         "straggler": straggler,
         "heartbeat_gaps_s": heartbeat_gaps,
@@ -490,6 +541,20 @@ def render_fleet_text(report: dict, postmortem: bool = False) -> str:
             for label, wait in sorted(report["collective_wait_s"].items())
         )
         lines.append(f"collective wait: {waits}")
+    for name, waits in sorted(
+        (report.get("collective_wait_by_span_s") or {}).items()
+    ):
+        per = ", ".join(
+            f"{label} {wait:.3f}s" for label, wait in sorted(waits.items())
+        )
+        lines.append(f"  span {name:<13s} {per}")
+    if report.get("trace_ids"):
+        ids = report["trace_ids"]
+        lines.append(
+            f"trace          : {ids[0]}"
+            + (f" (+{len(ids) - 1} more — propagation split the fleet!)"
+               if len(ids) > 1 else " (one trace across the fleet)")
+        )
     util = report.get("utilization")
     if util is not None:
         if util.get("available"):

@@ -131,6 +131,11 @@ class FlightRecorder:
                 except ValueError:
                     hang_timeout_s = None
         self.hang_timeout_s = hang_timeout_s
+        # Set by TelemetryRun.attach_flight_recorder: a zero-arg callable
+        # returning the tracer's open-span snapshots, flushed into every
+        # heartbeat and crashdump so the trace CLI can close a killed
+        # process's in-flight spans as `aborted` instead of losing them.
+        self.open_spans_provider = None
         self._beats = 0
         self._phase = "init"
         self._epoch: int | None = None
@@ -227,6 +232,7 @@ class FlightRecorder:
                 "age_since_beat_s": time.monotonic() - self._last_beat_mono,
                 "state": state,
                 "scalars": {k: list(v) for k, v in self._scalars.items()},
+                "open_spans": self._open_spans(),
                 "threads": _all_thread_stacks(),
                 "ring": list(self._ring),
             }
@@ -253,6 +259,15 @@ class FlightRecorder:
 
     # ----------------------------------------------------------- heartbeat
 
+    def _open_spans(self) -> list[dict]:
+        provider = self.open_spans_provider
+        if provider is None:
+            return []
+        try:
+            return list(provider())
+        except Exception:
+            return []  # forensics must never kill the run
+
     def _write_heartbeat(self, **extra) -> None:
         try:
             _atomic_write_json(
@@ -270,6 +285,7 @@ class FlightRecorder:
                     "beats": self._beats,
                     "interval_s": self.heartbeat_interval_s,
                     "hang_timeout_s": self.hang_timeout_s,
+                    "open_spans": self._open_spans(),
                     **extra,
                 },
             )

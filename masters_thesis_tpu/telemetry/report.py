@@ -247,6 +247,18 @@ def _restart_stats(events: list[dict], by_kind: dict) -> dict:
             )
             lost_work_s += max(0.0, last_ts - floor_ts)
 
+    # The trace id the attempt chain rides: the supervisor propagates ONE
+    # id forward through every retry (trace.py), so the restarts line can
+    # name the trace that stitches the attempts together.
+    trace_id = next(
+        (
+            ev["trace_id"]
+            for ev in (sup_started + starts)
+            if ev.get("trace_id")
+        ),
+        None,
+    )
+
     return {
         "attempts": attempts,
         "restarts": max(0, attempts - 1),
@@ -254,6 +266,7 @@ def _restart_stats(events: list[dict], by_kind: dict) -> dict:
         "degradations": len(by_kind.get("degradation", [])),
         "rollbacks": len(by_kind.get("rollback", [])),
         "resumed": any(e.get("resumed_from") for e in starts),
+        "trace_id": trace_id,
     }
 
 
@@ -581,6 +594,8 @@ def _render_restarts(r: dict) -> str:
     if r.get("rollbacks"):
         parts.append(f"{r['rollbacks']} rollback(s)")
     parts.append(f"{r.get('degradations', 0)} degradation event(s)")
+    if r.get("trace_id"):
+        parts.append(f"trace {r['trace_id']}")
     return "restarts       : " + ", ".join(parts)
 
 

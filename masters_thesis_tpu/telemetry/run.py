@@ -99,6 +99,7 @@ class TelemetryRun:
         # event is mirrored into its bounded ring so a crashdump carries the
         # run's recent history without re-reading the stream.
         self.recorder = None
+        self._tracer = None
         if meta:
             self.event("run_meta", meta=meta)
 
@@ -110,6 +111,18 @@ class TelemetryRun:
             self.recorder.record(ev)
         return ev
 
+    @property
+    def tracer(self):
+        """This run's span writer (lazy; adopts ``MTT_TRACE_ID`` /
+        ``MTT_PARENT_SPAN`` from the environment). Its open spans flow
+        into the flight recorder's heartbeat/crashdump sidecars so a
+        killed process's in-flight work is closed as ``aborted``."""
+        if self._tracer is None:
+            from masters_thesis_tpu.telemetry.trace import Tracer
+
+            self._tracer = Tracer(self.sink)
+        return self._tracer
+
     def attach_flight_recorder(self, **kwargs):
         """Attach (or return the already-attached) in-process flight
         recorder for this run: crashdump.json on SIGTERM/SIGQUIT/hang,
@@ -118,9 +131,23 @@ class TelemetryRun:
         not stack recorders."""
         if self.recorder is None:
             from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
+            from masters_thesis_tpu.telemetry.trace import (
+                adopt_orphaned_spans,
+            )
 
+            # A resumed-in-place attempt is about to overwrite the dead
+            # predecessor's sidecars — the only record of its open spans.
+            # Close them into the stream first, or the predecessor's
+            # child spans orphan once the new heartbeat lands.
+            adopt_orphaned_spans(self.run_dir, self.sink)
             self.recorder = FlightRecorder(
                 self.run_dir, run_id=self.run_id, sink=self.sink, **kwargs
+            )
+            # Late-bound so the tracer can attach before OR after the
+            # recorder without either knowing construction order.
+            self.recorder.open_spans_provider = (
+                lambda: self._tracer.open_spans()
+                if self._tracer is not None else []
             )
         return self.recorder
 
@@ -153,6 +180,12 @@ class TelemetryRun:
         return snap
 
     def close(self) -> None:
+        # Spans an exception path left open are closed `aborted` BEFORE
+        # the recorder writes its final (closed) heartbeat — a cleanly
+        # closed stream claiming open spans is the trace CLI's
+        # `unclosed` bug class, and must only mean real tracer misuse.
+        if self._tracer is not None:
+            self._tracer.close_all(status="aborted")
         if self.recorder is not None:
             self.recorder.close()
         self.sink.close()
@@ -252,14 +285,21 @@ class EpochRecorder:
         tel: TelemetryRun,
         steps_per_epoch: int,
         on_epoch: Callable[[dict], None] | None = None,
+        span_parent=None,
     ):
         self.tel = tel
         self.steps_per_epoch = steps_per_epoch
         # Called with each finalized epoch event payload — the trainer uses
         # it to mirror perf scalars into TensorBoard next to the loss curves.
         self.on_epoch = on_epoch
+        # When a parent span is given (the trainer's fit root), every
+        # finalized epoch also lands as a retroactive `train.epoch` span —
+        # same boundaries, same no-added-fences contract, just addressable
+        # by the trace CLI's critical-path attribution.
+        self.span_parent = span_parent
         self._open: dict | None = None  # the epoch awaiting its wall close
         self._t0: float | None = None
+        self._wall0: float | None = None  # wall clock twin of _t0
 
     # The trainer calls these in loop order; all are no-throw by design —
     # a telemetry bug must never kill a training run.
@@ -268,6 +308,7 @@ class EpochRecorder:
         now = time.perf_counter()
         self._finalize(now, fenced=False, device_s=None)
         self._t0 = now
+        self._wall0 = time.time()
         self._open = {"epoch": epoch}
 
     def dispatched(
@@ -294,7 +335,7 @@ class EpochRecorder:
             return
         ev, self._open = self._open, None
         wall = now - self._t0
-        self._t0 = None
+        wall0, self._t0, self._wall0 = self._wall0, None, None
         steps = self.steps_per_epoch
         compiled = bool(ev.get("compile_events"))
         self.tel.counter("train/epochs").inc()
@@ -315,6 +356,20 @@ class EpochRecorder:
             fenced=fenced,
             steps_per_sec=(steps / wall) if wall > 0 else None,
         )
+        if self.span_parent is not None and wall0 is not None:
+            self.tel.tracer.emit_span(
+                "train.epoch",
+                start_ts=wall0,
+                dur_s=wall,
+                parent=self.span_parent,
+                cat="train",
+                epoch=ev["epoch"],
+                dispatch_s=ev.get("dispatch_s"),
+                device_s=device_s,
+                data_wait_s=ev.get("data_wait_s", 0.0),
+                compiled=compiled,
+                fenced=fenced,
+            )
         if self.on_epoch is not None:
             try:
                 self.on_epoch(payload)

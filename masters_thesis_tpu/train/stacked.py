@@ -258,10 +258,13 @@ class StackedTrainer:
 
         # ---- telemetry wiring (same protocol as Trainer.fit, plus the
         # per-replica sub-streams `replica_epoch` / `replica_status`) ----
-        tracker = rec = flight = None
+        tracker = rec = flight = fit_span = None
         if tel:
             flight = tel.attach_flight_recorder()
             flight.beat(phase="setup")
+            fit_span = tel.tracer.start(
+                "trainer.fit", trainer="stacked", stacked_replicas=R
+            )
             tel.event(
                 "run_started",
                 platform=jax.default_backend(),
@@ -280,6 +283,7 @@ class StackedTrainer:
                 distributed=distributed_run_context(),
                 stacked_replicas=R,
                 replicas=[dataclasses.asdict(r) for r in replicas],
+                trace_id=tel.tracer.trace_id,
             )
             tel.gauge("train/collectives_per_step").set(num_buffers(fspec))
             tel.gauge("train/grad_reduce_bytes").set(
@@ -293,7 +297,7 @@ class StackedTrainer:
                 stacked_replicas=R,
             )
             tracker = CompileTracker(epoch_fn, size_fn=jit_cache_size)
-            rec = EpochRecorder(tel, steps_per_epoch)
+            rec = EpochRecorder(tel, steps_per_epoch, span_parent=fit_span)
 
         def active_lrs() -> jax.Array:
             # Masked replicas ride along at lr=0: their rows stay exactly
@@ -526,6 +530,11 @@ class StackedTrainer:
             if flight is not None:
                 flight.beat(phase="finished")
             tel.sample_memory(None)
+            tel.tracer.end(
+                fit_span,
+                status="error" if all_dead else "ok",
+                epochs=max((len(h) for h in histories), default=0),
+            )
             tel.event(
                 "run_finished",
                 epochs=max((len(h) for h in histories), default=0),

@@ -492,7 +492,7 @@ class Trainer:
         # Compile events are measured, not inferred: cache-miss deltas on
         # the hot program (scan epoch / stream step) and on eval_fn turn
         # tracelint's TA201 "compiles exactly once" into a runtime counter.
-        epoch_tracker = eval_tracker = rec = flight = None
+        epoch_tracker = eval_tracker = rec = flight = fit_span = None
         if tel:
             # Attach the flight recorder BEFORE the first event so the ring
             # buffer holds the whole run and SIGTERM/hang forensics cover the
@@ -501,6 +501,15 @@ class Trainer:
                 hang_timeout_s=self.hang_timeout_s
             )
             flight.beat(phase="setup")
+            # The run's root span: hangs off MTT_PARENT_SPAN when a
+            # supervisor/grid runner launched us, so every epoch/eval/
+            # checkpoint span below joins the cross-process trace. Host
+            # bookkeeping only — no fences (TL/TA contract unchanged).
+            fit_span = tel.tracer.start(
+                "trainer.fit", trainer=self.name, attempt_resume=bool(
+                    resumed_from),
+            )
+            self._fit_span = fit_span
             tel.event(
                 "run_started",
                 platform=jax.default_backend(),
@@ -515,6 +524,7 @@ class Trainer:
                 seed=self.seed,
                 resumed_from=resumed_from,
                 distributed=distributed_run_context(),
+                trace_id=tel.tracer.trace_id,
             )
             # Gradient-sync footprint of the flat update path: one collective
             # per dtype buffer per step (TA206 pins exactly this count in the
@@ -547,7 +557,10 @@ class Trainer:
                         ev["epoch"],
                     )
 
-            rec = EpochRecorder(tel, steps_per_epoch, on_epoch=_mirror_epoch)
+            rec = EpochRecorder(
+                tel, steps_per_epoch, on_epoch=_mirror_epoch,
+                span_parent=fit_span,
+            )
 
         # ---- static cost model of the hot program (telemetry/costs.py) ----
         # AOT lower+compile the exact program the loop runs and pull the
@@ -797,6 +810,8 @@ class Trainer:
                     halt(row)
                     break
                 if is_val:
+                    t_eval_wall = time.time()
+                    t_eval = time.perf_counter()
                     val_sums = eval_fn(params, *val_prepared)
                     val_metrics = metric_means(jax.device_get(val_sums))
                     row.update(
@@ -809,6 +824,15 @@ class Trainer:
                             epoch=epoch,
                             compile_events=eval_tracker.poll(),
                             val_loss=float(val_loss),
+                        )
+                        # device_get above already fenced the eval; the
+                        # span just names the interval retroactively.
+                        tel.tracer.emit_span(
+                            "train.eval",
+                            start_ts=t_eval_wall,
+                            dur_s=time.perf_counter() - t_eval,
+                            parent=fit_span,
+                            epoch=epoch,
                         )
                     row["lr-Adam"] = scheduler.step(val_loss)
                     if val_loss < best_val:
@@ -870,6 +894,13 @@ class Trainer:
             if flight is not None:
                 flight.beat(phase="finished")
             tel.sample_memory(None)
+            tel.tracer.end(
+                fit_span,
+                status="error" if diverged else "ok",
+                epochs=len(history),
+                diverged=diverged,
+            )
+            self._fit_span = None
             tel.event(
                 "run_finished",
                 epochs=len(history),
@@ -928,6 +959,7 @@ class Trainer:
               scheduler=None, best_val=None):
         if not self.ckpt_dir:
             return
+        t0_wall = time.time()
         t0 = time.perf_counter()
         ckpt_lib.save_checkpoint(
             self.ckpt_dir, tag, params, opt_state, spec,
@@ -952,12 +984,21 @@ class Trainer:
             # Lost-work accounting: `telemetry summarize` measures the gap
             # between a dead attempt's last activity and its last
             # checkpoint_saved to report how much training a restart cost.
+            wall_s = time.perf_counter() - t0
             self.telemetry.event(
                 "checkpoint_saved",
                 tag=tag,
                 epoch=epoch,
-                wall_s=time.perf_counter() - t0,
+                wall_s=wall_s,
                 path=str(self.ckpt_dir / tag),
+            )
+            self.telemetry.tracer.emit_span(
+                "train.checkpoint",
+                start_ts=t0_wall,
+                dur_s=wall_s,
+                parent=getattr(self, "_fit_span", None),
+                tag=tag,
+                epoch=epoch,
             )
 
     def _print(self, msg: str) -> None:

@@ -59,6 +59,10 @@ from masters_thesis_tpu.resilience.supervisor import (  # noqa: E402
     RunSupervisor,
     SupervisorConfig,
 )
+from masters_thesis_tpu.telemetry.trace import (  # noqa: E402
+    TRACE_ENV,
+    new_trace_id,
+)
 
 REPO = Path(__file__).resolve().parent.parent
 RESULTS_DIR = REPO / "results"
@@ -198,6 +202,11 @@ def train_with_retry(
     LR. Per-attempt stdout/stderr land in <log_dir>/supervisor/."""
     budget = min(budget, max(60.0, deadline - time.time()))
     log_dir = ckpt.parent.parent if ckpt is not None else None
+    # Fresh trace id per cell: each cell is its own trace (all its
+    # supervisor attempts ride it), never inherited from the runner's own
+    # environment — a runner-wide id would fuse every cell into one trace.
+    env = dict(os.environ)
+    env[TRACE_ENV] = new_trace_id()
     sup = RunSupervisor(
         cmd or [sys.executable, "train.py", *train_overrides,
                 "trainer.resume=auto", "trainer.enable_model_summary=false"],
@@ -209,6 +218,7 @@ def train_with_retry(
             retry_budget_s=budget,
             attempt_timeout_s=budget,
         ),
+        env=env,
         cwd=REPO,
         watch_dir=(log_dir / "telemetry") if log_dir else None,
         ckpt_dir=(ckpt.parent if ckpt is not None else None),

@@ -47,10 +47,14 @@ def main() -> None:
         hang_timeout_s=0.5 if scenario == "victim-hang" else None,
     )
     rec.beat(phase="setup")
+    # The tracer adopts MTT_TRACE_ID / MTT_PARENT_SPAN from the env the
+    # test (or a real supervisor) exported — one trace across the fleet.
+    fit_span = tel.tracer.start("trainer.fit", trainer="fleet", rank=rank)
     tel.event(
         "run_started", platform="sim", n_devices=1, strategy="fleet-sim",
         epoch_mode="scan", steps_per_epoch=4, max_epochs=3, start_epoch=0,
         objective="mse", trainer="fleet", seed=0,
+        trace_id=tel.tracer.trace_id,
     )
     epochs = 3 if scenario == "healthy" else 2
     for epoch in range(epochs):
@@ -63,8 +67,14 @@ def main() -> None:
             device_s=None, data_wait_s=0.0, compile_events=0,
             compiled=False, fenced=False, steps_per_sec=4.0 / wall,
         )
+        tel.tracer.emit_span(
+            "train.epoch", start_ts=time.time() - wall, dur_s=wall,
+            parent=fit_span, epoch=epoch, dispatch_s=0.001,
+            data_wait_s=0.0,
+        )
 
     if scenario == "healthy":
+        tel.tracer.end(fit_span, status="ok", epochs=epochs)
         tel.event(
             "run_finished", epochs=epochs, total_steps=4 * epochs,
             steps_per_sec=40.0, diverged=False, best_val=0.5,

@@ -1,0 +1,451 @@
+"""Distributed tracing (ISSUE 12): span writer, cross-process trace
+propagation, open-span recovery past SIGKILL, and the jax-free ``trace``
+CLI (Perfetto export + critical-path attribution).
+
+The subprocess scenarios reuse tests/_fleet_worker.py (jax-free) so the
+propagation tests exercise exactly the env contract real supervisors and
+fleets use: ``MTT_TRACE_ID`` carries the trace, ``MTT_PARENT_SPAN`` the
+parent span id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.resilience.supervisor import (
+    RunSupervisor,
+    SupervisorConfig,
+)
+from masters_thesis_tpu.telemetry.__main__ import main as cli_main
+from masters_thesis_tpu.telemetry.aggregate import aggregate_path
+from masters_thesis_tpu.telemetry.events import EventSink, read_events
+from masters_thesis_tpu.telemetry.run import TelemetryRun
+from masters_thesis_tpu.telemetry.trace import (
+    PARENT_SPAN_ENV,
+    TRACE_ENV,
+    Tracer,
+    build_trace_report,
+    child_env,
+    collect_spans,
+    new_trace_id,
+    validate_spans,
+)
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_WORKER = _REPO_ROOT / "tests" / "_fleet_worker.py"
+
+
+def _spans(path: Path) -> list[dict]:
+    return [e for e in read_events(path) if e.get("kind") == "span"]
+
+
+# ------------------------------------------------------------------ writer
+
+
+class TestTracer:
+    def test_span_event_schema_and_nesting(self, tmp_path):
+        sink = EventSink(tmp_path / "events.jsonl", run_id="t")
+        tr = Tracer(sink, env={})
+        outer = tr.start("trainer.fit", trainer="test")
+        inner = tr.start("train.eval", parent=outer, epoch=3)
+        tr.end(inner)
+        tr.end(outer, status="ok", epochs=1)
+        sink.close()
+        spans = _spans(tmp_path / "events.jsonl")
+        assert [s["name"] for s in spans] == ["trainer.fit", "train.eval"][
+            ::-1
+        ]  # close order: inner first
+        by_name = {s["name"]: s for s in spans}
+        fit, ev = by_name["trainer.fit"], by_name["train.eval"]
+        assert ev["parent_id"] == fit["span_id"]
+        assert ev["trace_id"] == fit["trace_id"] == tr.trace_id
+        assert fit["parent_id"] is None and not fit["ext"]
+        assert ev["attrs"]["epoch"] == 3
+        assert fit["attrs"] == {"trainer": "test", "epochs": 1}
+        assert fit["dur_s"] >= 0 and fit["status"] == "ok"
+        # cat defaults to the name's first dotted segment.
+        assert fit["cat"] == "trainer" and ev["cat"] == "train"
+
+    def test_context_manager_marks_errors(self, tmp_path):
+        sink = EventSink(tmp_path / "events.jsonl", run_id="t")
+        tr = Tracer(sink, env={})
+        with pytest.raises(ValueError):
+            with tr.span("serve.batch"):
+                raise ValueError("boom")
+        sink.close()
+        (span,) = _spans(tmp_path / "events.jsonl")
+        assert span["status"] == "error"
+
+    def test_env_round_trip_is_not_an_orphan(self, tmp_path):
+        parent_sink = EventSink(
+            tmp_path / "parent" / "events.jsonl", run_id="parent"
+        )
+        tr1 = Tracer(parent_sink, env={})
+        root = tr1.start("supervisor.run")
+        env = child_env(parent=root, env={}, trace_id=tr1.trace_id)
+        assert env[TRACE_ENV] == tr1.trace_id
+        assert env[PARENT_SPAN_ENV] == root.span_id
+
+        child_sink = EventSink(
+            tmp_path / "child" / "events.jsonl", run_id="child"
+        )
+        tr2 = Tracer(child_sink, env=env)
+        assert tr2.trace_id == tr1.trace_id
+        fit = tr2.start("trainer.fit")
+        assert fit.parent_id == root.span_id and fit.ext
+        tr2.end(fit)
+        tr1.end(root)
+        child_sink.close()
+        parent_sink.close()
+        # The child stream READ ALONE must not flag its env-external root
+        # as an orphan — the parent's stream may be out of scope.
+        collected = collect_spans(tmp_path / "child")
+        assert validate_spans(collected["spans"], collected["problems"]) == []
+
+    def test_close_all_closes_children_before_parents(self, tmp_path):
+        sink = EventSink(tmp_path / "events.jsonl", run_id="t")
+        tr = Tracer(sink, env={})
+        outer = tr.start("a.outer")
+        time.sleep(0.01)
+        tr.start("a.inner", parent=outer)
+        assert tr.close_all(status="aborted") == 2
+        sink.close()
+        spans = _spans(tmp_path / "events.jsonl")
+        assert [s["name"] for s in spans] == ["a.inner", "a.outer"]
+        assert all(s["status"] == "aborted" for s in spans)
+
+    def test_telemetry_run_close_aborts_open_spans(self, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="t")
+        tel.tracer.start("trainer.fit")
+        tel.close()
+        (span,) = _spans(tmp_path / "events.jsonl")
+        assert span["name"] == "trainer.fit"
+        assert span["status"] == "aborted"
+
+    def test_reused_run_dir_adopts_predecessor_open_spans(self, tmp_path):
+        """A supervised retry resuming IN PLACE re-opens the same run dir
+        and overwrites the dead attempt's heartbeat — the only record of
+        its open fit span. attach_flight_recorder must close that span
+        into the stream first, or the dead attempt's epoch spans orphan
+        (found on a real supervised train run with an injected SIGKILL)."""
+        tel1 = TelemetryRun(tmp_path, run_id="a1")
+        rec1 = tel1.attach_flight_recorder(
+            install_signal_handlers=False,
+            enable_faulthandler=False,
+            heartbeat_interval_s=60.0,
+        )
+        fit = tel1.tracer.start("trainer.fit")
+        tel1.tracer.emit_span(
+            "train.epoch", start_ts=time.time(), dur_s=0.1, parent=fit,
+            epoch=0, dispatch_s=0.01, data_wait_s=0.0,
+        )
+        rec1._write_heartbeat()
+        rec1._closed.set()  # stop the beat thread: SIGKILL writes nothing
+
+        tel2 = TelemetryRun(tmp_path, run_id="a2")
+        tel2.attach_flight_recorder(
+            install_signal_handlers=False,
+            enable_faulthandler=False,
+            heartbeat_interval_s=60.0,
+        )
+        with tel2.tracer.span("trainer.fit"):
+            pass
+        tel2.close()
+
+        collected = collect_spans(tmp_path)
+        assert validate_spans(collected["spans"], collected["problems"]) == []
+        adopted = next(
+            s for s in collected["spans"] if s["span_id"] == fit.span_id
+        )
+        assert adopted["status"] == "aborted"
+        assert adopted["attrs"]["synthesized"] is True
+
+    def test_flight_recorder_sidecars_carry_open_spans(self, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="t")
+        rec = tel.attach_flight_recorder(
+            install_signal_handlers=False,
+            enable_faulthandler=False,
+            heartbeat_interval_s=60.0,
+        )
+        span = tel.tracer.start("trainer.fit", trainer="test")
+        rec.dump("signal:SIGTERM (test)")
+        dump = json.loads((tmp_path / "crashdump.json").read_text())
+        names = [s["name"] for s in dump["open_spans"]]
+        assert names == ["trainer.fit"]
+        assert dump["open_spans"][0]["span_id"] == span.span_id
+        tel.close()
+        hb = json.loads((tmp_path / "heartbeat.json").read_text())
+        # close_all ran before the final heartbeat: nothing left open.
+        assert hb["closed"] is True and hb["open_spans"] == []
+
+
+# --------------------------------------------------------------- trace CLI
+
+
+def _write_epoch_stream(root: Path, trace_id: str, walls=(0.5, 0.4, 0.6)):
+    sink = EventSink(root / "events.jsonl", run_id="run")
+    tr = Tracer(sink, env={TRACE_ENV: trace_id})
+    fit = tr.start("trainer.fit")
+    t0 = time.time() - 60.0
+    for ep, wall in enumerate(walls):
+        tr.emit_span(
+            "train.epoch", start_ts=t0 + ep, dur_s=wall, parent=fit,
+            epoch=ep, dispatch_s=0.04 * wall, data_wait_s=0.01 * wall,
+        )
+    tr.end(fit)
+    sink.close()
+
+
+class TestTraceCli:
+    def test_report_and_chrome_export(self, tmp_path, capsys):
+        trace_id = new_trace_id()
+        _write_epoch_stream(tmp_path / "run", trace_id)
+        out = tmp_path / "trace.json"
+        assert cli_main(
+            ["trace", str(tmp_path / "run"), "--out", str(out)]
+        ) == 0
+        text = capsys.readouterr().out
+        assert "span tree      : ok" in text
+        assert "epoch median" in text
+        chrome = json.loads(out.read_text())
+        events = chrome["traceEvents"]
+        assert all({"ph", "pid"} <= set(e) for e in events)
+        x_events = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in x_events} == {"trainer.fit", "train.epoch"}
+        assert any(
+            e["ph"] == "M" and e["name"] == "process_name" for e in events
+        )
+        report = build_trace_report(tmp_path / "run")
+        med = report["epoch"]["median"]
+        assert med["sum_ok"] and med["epoch"] == 0  # 0.5 is the median wall
+        assert med["wall_s"] == pytest.approx(0.5)
+        comp = med["components_s"]
+        assert sum(comp.values()) == pytest.approx(0.5)
+
+    def test_no_spans_exits_1(self, tmp_path):
+        (tmp_path / "events.jsonl").write_text("")
+        assert cli_main(["trace", str(tmp_path)]) == 1
+        assert cli_main(["trace", str(tmp_path / "missing")]) == 1
+
+    def test_broken_tree_exits_2(self, tmp_path):
+        sink = EventSink(tmp_path / "events.jsonl", run_id="bad")
+        tr = Tracer(sink, env={})
+        tr.emit_span("x.orphan", start_ts=1.0, dur_s=1.0, parent="feedfeed")
+        tr.emit_span("x.negative", start_ts=2.0, dur_s=-0.5)
+        sink.close()
+        assert cli_main(["trace", str(tmp_path)]) == 2
+        report = build_trace_report(tmp_path)
+        assert {p["kind"] for p in report["problems"]} == {
+            "orphan", "negative_duration",
+        }
+
+    def test_selfcheck_green(self, capsys):
+        assert cli_main(["trace", "--selfcheck"]) == 0
+        assert "trace selfcheck: ok" in capsys.readouterr().out
+
+
+# ------------------------------------------------- serve path attribution
+
+
+class TestServeTracing:
+    """Jax-free: the fake engine from the serve selfcheck drives the REAL
+    queue/admission/dispatch loop, so the per-request spans and their
+    component tiling are exactly what production emits."""
+
+    def _server(self, tmp_path, **kwargs):
+        from masters_thesis_tpu.serve.__main__ import _FakeEngine
+        from masters_thesis_tpu.serve.server import PredictServer
+
+        tel = TelemetryRun(tmp_path / "serve", run_id="serve-test")
+        engine = _FakeEngine(service_s=0.002)
+        server = PredictServer(engine, telemetry=tel, **kwargs)
+        return tel, engine, server
+
+    def test_request_spans_tile_the_wall(self, tmp_path):
+        tel, engine, server = self._server(tmp_path, max_wait_s=0.001)
+        server.start()
+        x = np.zeros(engine.window_shape, np.float32)
+        pending = [server.submit(x, deadline_s=5.0) for _ in range(12)]
+        results = [p.result(timeout=10.0) for p in pending]
+        stats = server.stop()
+        tel.close()
+        assert all(r.ok for r in results)
+        assert 0.0 <= stats["queue_wait_share"] <= 1.0
+        assert 0.0 < stats["compute_share"] <= 1.0
+
+        report = build_trace_report(tmp_path)
+        assert report["exit_code"] == 0
+        serve = report["serve"]
+        assert serve["requests"] == 12 and serve["completed"] == 12
+        for which in ("p50", "p99"):
+            b = serve[which]
+            assert b["sum_ok"], f"{which} components do not cover wall: {b}"
+            assert sum(b["components_s"].values()) == pytest.approx(
+                b["wall_s"]
+            )
+        # The batch-level device span rides the server root span.
+        spans = collect_spans(tmp_path)["spans"]
+        device = [s for s in spans if s["name"] == "serve.device"]
+        server_span = next(s for s in spans if s["name"] == "serve.server")
+        assert device
+        assert all(s["parent_id"] == server_span["span_id"] for s in device)
+
+    def test_shed_categorized_and_closed_as_shed(self, tmp_path):
+        tel, engine, server = self._server(tmp_path)
+        server.start()
+        server.service_model.seed(10.0)  # force infeasible deadlines
+        x = np.zeros(engine.window_shape, np.float32)
+        r = server.submit(x, deadline_s=0.01).result(timeout=5.0)
+        assert r.status == "shed"
+        stats = server.stop()
+        tel.close()
+        assert stats["shed_by_reason"] == {"deadline_infeasible": 1}
+        report = build_trace_report(tmp_path)
+        assert report["exit_code"] == 0
+        assert report["serve"]["shed"] == 1
+        assert report["serve"]["shed_by_reason"] == {
+            "deadline_infeasible": 1,
+        }
+
+
+# ------------------------------------------- cross-process propagation
+
+
+def _spawn(root: Path, rank: int, scenario: str, env: dict):
+    return subprocess.Popen(
+        [sys.executable, str(_WORKER), str(root), str(rank), "2", scenario],
+        cwd=_REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+class TestPropagation:
+    def test_sigkill_mid_epoch_aborts_open_spans(self, tmp_path):
+        """SIGKILL leaves no crashdump — the periodic heartbeat is the
+        only record of the victim's open fit span. The trace CLI must
+        close it as ``aborted`` (exit 0), never flag it orphaned."""
+        trace_id = new_trace_id()
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(_REPO_ROOT),
+            TRACE_ENV: trace_id,
+        }
+        p0 = _spawn(tmp_path, 0, "healthy", env)
+        p1 = _spawn(tmp_path, 1, "victim-sigterm", env)
+        try:
+            line = p1.stdout.readline().strip()
+            assert line == "ready", f"worker said {line!r}"
+            time.sleep(0.4)  # let a heartbeat flush the open fit span
+            p1.kill()  # SIGKILL: no handler, no crashdump
+            p1.wait(timeout=30)
+            assert p0.wait(timeout=30) == 0
+        finally:
+            for p in (p0, p1):
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        hb = json.loads((tmp_path / "p1" / "heartbeat.json").read_text())
+        assert not hb.get("closed")
+        assert any(
+            s["name"] == "trainer.fit" for s in hb["open_spans"]
+        )
+        out = tmp_path / "trace.json"
+        report = build_trace_report(tmp_path, out=out)
+        assert report["exit_code"] == 0, report["problems"]
+        assert report["aborted"] >= 1
+        aborted = [
+            s for s in collect_spans(tmp_path)["spans"]
+            if s["status"] == "aborted"
+        ]
+        assert any(s["name"] == "trainer.fit" for s in aborted)
+        # ONE trace id across both processes, adopted from the env.
+        assert list(report["traces"]) == [trace_id]
+        assert report["traces"][trace_id]["streams"] == ["p0", "p1"]
+        assert json.loads(out.read_text())["traceEvents"]
+
+    def test_fleet_span_merge_and_wait_attribution(self, tmp_path):
+        trace_id = new_trace_id()
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(_REPO_ROOT),
+            TRACE_ENV: trace_id,
+        }
+        procs = [_spawn(tmp_path, r, "healthy", env) for r in (0, 1)]
+        try:
+            assert all(p.wait(timeout=30) == 0 for p in procs)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        report = aggregate_path(tmp_path)
+        assert report["trace_ids"] == [trace_id]
+        # Rank-skewed walls (0.05 vs 0.10 over 3 shared epochs): p0 waits
+        # on p1 in every epoch, attributed to the NAMED epoch span.
+        waits = report["collective_wait_by_span_s"]["train.epoch"]
+        assert waits["p0"] == pytest.approx(0.15, abs=0.01)
+        assert waits["p1"] == pytest.approx(0.0, abs=0.01)
+
+    def test_supervised_restart_keeps_one_trace_id(self, tmp_path, capsys):
+        """The supervisor propagates ONE stable trace id FORWARD through
+        every retry; each attempt hangs off its own supervisor.attempt
+        span via MTT_PARENT_SPAN."""
+        log = tmp_path / "attempt_env.log"
+        code = (
+            "import os, sys; "
+            "open(sys.argv[1], 'a').write("
+            "os.environ.get('MTT_TRACE_ID', '') + ' ' "
+            "+ os.environ.get('MTT_PARENT_SPAN', '') + '\\n'); "
+            "print('RuntimeError: boom-' + os.environ['MTT_ATTEMPT'], "
+            "file=sys.stderr); "
+            "sys.exit(9)"
+        )
+        sup = RunSupervisor(
+            [sys.executable, "-c", code, str(log)],
+            run_dir=tmp_path / "sup",
+            cfg=SupervisorConfig(
+                max_retries=1, backoff_s=0.05, backoff_factor=1.0
+            ),
+        )
+        res = sup.run()
+        assert not res.ok and res.n_attempts == 2
+
+        lines = [ln.split() for ln in log.read_text().splitlines()]
+        assert len(lines) == 2
+        (tid1, parent1), (tid2, parent2) = lines
+        assert tid1 == tid2 == sup.trace_id
+        assert parent1 and parent2 and parent1 != parent2
+
+        events = read_events(tmp_path / "sup" / "events.jsonl")
+        spans = [e for e in events if e.get("kind") == "span"]
+        by_name: dict[str, list] = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert len(by_name["supervisor.attempt"]) == 2
+        assert len(by_name["supervisor.run"]) == 1
+        run_span = by_name["supervisor.run"][0]
+        assert all(
+            s["parent_id"] == run_span["span_id"]
+            and s["trace_id"] == sup.trace_id
+            for s in by_name["supervisor.attempt"]
+        )
+        # Each attempt's exported parent is its own attempt span.
+        assert {parent1, parent2} == {
+            s["span_id"] for s in by_name["supervisor.attempt"]
+        }
+        started = [e for e in events if e.get("kind") == "attempt_started"]
+        assert all(e.get("trace_id") == sup.trace_id for e in started)
+        # The summarize restarts line names the trace stitching the chain.
+        cli_main(["summarize", str(tmp_path / "sup")])
+        assert f"trace {sup.trace_id}" in capsys.readouterr().out

@@ -33,6 +33,8 @@ echo "== telemetry postmortem selfcheck =="
 python -m masters_thesis_tpu.telemetry postmortem --selfcheck || fail=1
 echo "== telemetry ledger selfcheck =="
 python -m masters_thesis_tpu.telemetry ledger --selfcheck || fail=1
+echo "== telemetry trace selfcheck =="
+python -m masters_thesis_tpu.telemetry trace --selfcheck || fail=1
 
 # 3b. resilience: supervisor end-to-end against jax-free workers
 #     (preempt -> resume, deterministic crash -> halt, NaN -> rollback)
