@@ -25,6 +25,8 @@ __all__ = [
     "FaultInjected",
     "FaultPlan",
     "FaultSpec",
+    "ReplicaRestartPolicy",
+    "ReplicaVerdict",
     "RunSupervisor",
     "SupervisorConfig",
 ]
@@ -33,7 +35,13 @@ __all__ = [
 def __getattr__(name: str):
     # Lazy: keep `import masters_thesis_tpu.resilience` cheap for the
     # fault-point fast path inside the trainer hot loop.
-    if name in ("RunSupervisor", "SupervisorConfig", "SupervisorResult"):
+    if name in (
+        "ReplicaRestartPolicy",
+        "ReplicaVerdict",
+        "RunSupervisor",
+        "SupervisorConfig",
+        "SupervisorResult",
+    ):
         from masters_thesis_tpu.resilience import supervisor
 
         return getattr(supervisor, name)

@@ -75,6 +75,14 @@ POINTS = frozenset(
         "serve.admit",  # request admission (kind: wedge -> forced shed)
         "serve.dispatch",  # micro-batch dispatch (wedge -> device error)
         "serve.pre_swap",  # hot-swap candidate staged (kind: corrupt)
+        "serve.replica_dispatch",  # fleet replica dispatch: wedge -> device
+        # error (breaker evidence), raise -> fatal replica death, hang ->
+        # replica worker hangs (watchdog territory), corrupt -> poisoned
+        # outputs. Match on {"replica": "r0"} to target one replica.
+        "serve.replica_boot",  # fleet replica (re)boot (wedge -> boot
+        # failure; the restart policy classifies the repeat)
+        "cache.load",  # program-cache entry load (corrupt -> byte flipped
+        # on disk, exercising the torn-entry refusal)
     }
 )
 

@@ -107,6 +107,89 @@ class AttemptOutcome:
 
 
 @dataclass
+class ReplicaVerdict:
+    """What the fleet should do with a dead serving replica."""
+
+    action: str  # restart | halt
+    kind: str  # transient | deterministic | budget_exhausted
+    backoff_s: float
+    detail: str = ""
+
+
+class ReplicaRestartPolicy:
+    """Evidence-based restart classification for serving-fleet replicas.
+
+    The same discipline as :meth:`RunSupervisor._classify`, applied
+    in-process (a fleet replica is a thread + device subset, not a child
+    process): a replica death is retriable until the EVIDENCE says
+    otherwise —
+
+    - the same failure fingerprint on two CONSECUTIVE deaths is
+      deterministic by evidence (a restart would replay the identical
+      failure forever);
+    - a per-replica restart budget bounds a flapping replica, so the
+      fleet converges to draining it instead of thrashing its devices;
+    - restarts back off exponentially (serving backoffs are milliseconds,
+      not the supervisor's seconds — a dead replica is capacity, and the
+      queue is shedding what it can't cover).
+
+    A successful serve resets the fingerprint chain (:meth:`note_healthy`):
+    a crash, an hour of clean traffic, then the same crash is a fresh
+    incident, not a reproduction. Jax-free; called from the fleet's
+    monitor thread.
+    """
+
+    def __init__(
+        self,
+        max_restarts: int = 3,
+        backoff_s: float = 0.05,
+        backoff_factor: float = 2.0,
+        max_backoff_s: float = 2.0,
+    ):
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_factor = backoff_factor
+        self.max_backoff_s = max_backoff_s
+        self._state: dict[str, dict] = {}
+
+    def _entry(self, replica: str) -> dict:
+        return self._state.setdefault(
+            replica, {"restarts": 0, "last_fp": None}
+        )
+
+    def note_healthy(self, replica: str) -> None:
+        entry = self._state.get(replica)
+        if entry is not None:
+            entry["last_fp"] = None
+
+    def restarts(self, replica: str) -> int:
+        return self._entry(replica)["restarts"]
+
+    def classify(
+        self, replica: str, fingerprint: str, detail: str = ""
+    ) -> ReplicaVerdict:
+        entry = self._entry(replica)
+        if fingerprint and entry["last_fp"] == fingerprint:
+            return ReplicaVerdict(
+                "halt", "deterministic", 0.0,
+                f"identical failure fingerprint on consecutive deaths "
+                f"({fingerprint}): {detail}",
+            )
+        if entry["restarts"] >= self.max_restarts:
+            return ReplicaVerdict(
+                "halt", "budget_exhausted", 0.0,
+                f"restart budget exhausted ({self.max_restarts}): {detail}",
+            )
+        entry["last_fp"] = fingerprint
+        entry["restarts"] += 1
+        backoff = min(
+            self.backoff_s * self.backoff_factor ** (entry["restarts"] - 1),
+            self.max_backoff_s,
+        )
+        return ReplicaVerdict("restart", "transient", backoff, detail)
+
+
+@dataclass
 class SupervisorResult:
     ok: bool
     verdict: str  # completed | deterministic | retries_exhausted |

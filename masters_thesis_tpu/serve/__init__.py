@@ -11,8 +11,15 @@ The serving stack, bottom to top:
 - :mod:`~masters_thesis_tpu.serve.server` — the dispatch loop: deadline
   enforcement (no late answers, ever) and the circuit-breaker CPU
   degradation policy.
+- :mod:`~masters_thesis_tpu.serve.fleet` — N engine replicas on disjoint
+  device subsets behind one queue: least-loaded dispatch, per-replica
+  health states, dead-replica re-dispatch, supervised restart (jax-free).
+- :mod:`~masters_thesis_tpu.serve.program_cache` — content-addressed
+  on-disk cache of serialized predict executables: restarts and hot-swaps
+  boot with zero compiles; torn/stale entries refused, never trusted.
 - :mod:`~masters_thesis_tpu.serve.preflight` — tracelint-style audit of
-  the hot path (SV301–SV303): zero recompiles, no implicit transfers.
+  the hot path (SV301–SV306): zero recompiles, no implicit transfers,
+  warm-cache zero-compile boot, single-replica-death survival.
 
 Importing this package (and queue/server) stays jax-free so
 ``python -m masters_thesis_tpu.serve selfcheck`` runs on machines where
@@ -27,9 +34,22 @@ from masters_thesis_tpu.serve.queue import (
     ServeResponse,
     ServiceTimeModel,
 )
+from masters_thesis_tpu.serve.fleet import (
+    FleetServer,
+    Replica,
+    ReplicaBootError,
+)
 from masters_thesis_tpu.serve.server import InjectedDeviceError, PredictServer
+from masters_thesis_tpu.serve.spans import RequestSpans
 
 _LAZY = {
+    "ProgramCache": (
+        "masters_thesis_tpu.serve.program_cache", "ProgramCache",
+    ),
+    "entry_key": ("masters_thesis_tpu.serve.program_cache", "entry_key"),
+    "param_signature": (
+        "masters_thesis_tpu.serve.program_cache", "param_signature",
+    ),
     "PredictEngine": ("masters_thesis_tpu.serve.engine", "PredictEngine"),
     "BucketOverflowError": (
         "masters_thesis_tpu.serve.engine", "BucketOverflowError",
@@ -62,10 +82,14 @@ def __getattr__(name: str):
 
 
 __all__ = [
+    "FleetServer",
     "InjectedDeviceError",
     "MicroBatchQueue",
     "PendingRequest",
     "PredictServer",
+    "Replica",
+    "ReplicaBootError",
+    "RequestSpans",
     "ServeRequest",
     "ServeResponse",
     "ServiceTimeModel",

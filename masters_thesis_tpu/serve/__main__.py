@@ -11,8 +11,11 @@ Subcommands:
 - ``preflight`` — the serve twin of tracelint Pass 2 on a hermetic
   8-device virtual CPU mesh: every bucket compiles exactly once, zero
   compile delta in steady state, hot path clean under
-  ``transfer_guard("disallow")`` (rules SV301–SV303). Exit 1 on findings;
-  the other tools/check.sh serve gate.
+  ``transfer_guard("disallow")`` (SV301–SV304), plus the fleet-era rules:
+  warm program-cache boot performs zero compiles (SV305) and a single
+  injected replica death leaves >= 1 serving replica with every request
+  explicitly resolved (SV306). Exit 1 on findings; the other
+  tools/check.sh serve gate.
 """
 
 from __future__ import annotations
@@ -37,6 +40,7 @@ class _FakeEngine:
         self.window_shape = (2, 3, 1)
         self.max_bucket = self.buckets[-1]
         self.compile_events = len(self.buckets)
+        self.cache_hits = 0
         self.platform = "fake"
         self.fail_next = 0  # raise on the next N predict calls
         self.degraded = False
@@ -202,6 +206,50 @@ def _selfcheck(args) -> int:
                 f"breaker: post-degrade request {ok_after.status!r}"
             )
 
+    # 6. Fleet failover, jax-free: three fake replicas, one killed by an
+    #    injected dispatch crash mid-traffic. Survivors absorb the
+    #    re-dispatched work, the dead replica restarts a new generation,
+    #    and not one answer is delivered late or silently dropped.
+    from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
+    from masters_thesis_tpu.serve.fleet import FleetServer
+
+    fleet = FleetServer(
+        {f"r{i}": (lambda: _FakeEngine(service_s=0.002)) for i in range(3)},
+        max_wait_s=0.002,
+        hang_timeout_s=0.5,
+        restart_policy=ReplicaRestartPolicy(backoff_s=0.01),
+    )
+    fleet.start()
+    plan = faults.FaultPlan.parse(
+        '{"faults": [{"point": "serve.replica_dispatch", "kind": "raise",'
+        ' "attempt": null, "match": {"replica": "r1"}}]}'
+    )
+    faults.install_plan(plan)
+    try:
+        pending = [fleet.submit(window, deadline_s=2.0) for _ in range(30)]
+        deadline = time.monotonic() + 5.0
+        while (
+            fleet.replicas["r1"].state != "dead"
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        results = [p.result(timeout=10.0) for p in pending]
+    finally:
+        faults.clear_plan()
+    stats = fleet.stop()
+    if stats["deaths"] < 1:
+        failures.append(f"fleet: injected crash never killed r1 ({stats})")
+    if stats["n_live"] < 1 and stats["replicas"]["r1"]["generation"] < 2:
+        failures.append(f"fleet: no survivor and no restart ({stats})")
+    if stats["late_deliveries"] != 0:
+        failures.append(
+            f"fleet: {stats['late_deliveries']} late ok-deliveries"
+        )
+    bad = [r.status for r in results
+           if r.status not in ("ok", "shed", "rejected_late")]
+    if bad:
+        failures.append(f"fleet: non-explicit outcomes {sorted(set(bad))}")
+
     if failures:
         print("serve: selfcheck FAILED: " + "; ".join(failures))
         return 1
@@ -227,12 +275,21 @@ def _force_cpu_mesh(n_devices: int) -> None:
 def _preflight(args) -> int:
     _force_cpu_mesh(args.devices)
     from masters_thesis_tpu.analysis.findings import format_report
-    from masters_thesis_tpu.serve.preflight import run_serve_preflight
+    from masters_thesis_tpu.serve.preflight import (
+        run_fleet_preflight,
+        run_program_cache_preflight,
+        run_serve_preflight,
+    )
 
     findings = run_serve_preflight(requests=args.requests)
+    findings += run_program_cache_preflight()
+    findings += run_fleet_preflight()
     print(format_report(findings, as_json=args.json))
     if not findings and not args.json:
-        print("serve: preflight ok (zero recompiles, transfer-clean)")
+        print(
+            "serve: preflight ok (zero recompiles, transfer-clean, "
+            "warm-cache boot compile-free, fleet survives replica death)"
+        )
     return 1 if findings else 0
 
 

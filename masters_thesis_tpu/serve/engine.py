@@ -69,6 +69,7 @@ class PredictEngine:
         n_features: int = 3,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         mesh: Mesh | None = None,
+        program_cache=None,
     ):
         self.spec = spec
         self.n_stocks = n_stocks
@@ -83,6 +84,14 @@ class PredictEngine:
         #: Steady-state contract: constant after warmup() — the preflight
         #: asserts the delta is zero over a varied-shape request window.
         self.compile_events = 0
+        #: Buckets booted from the on-disk program cache instead of a
+        #: compile. A fully warm boot has cache_hits == len(buckets) and
+        #: compile_events == 0 (preflight rule SV305).
+        self.cache_hits = 0
+        #: Optional :class:`~masters_thesis_tpu.serve.program_cache
+        #: .ProgramCache`: serialized executables keyed on the full
+        #: program identity; torn/stale entries are refused and rebuilt.
+        self.program_cache = program_cache
         self._compiled: dict[int, tuple[Any, NamedSharding]] = {}
         #: Static cost model per bucket (telemetry/costs.py payload dict),
         #: extracted from the very Compiled executables that serve traffic
@@ -116,6 +125,85 @@ class PredictEngine:
         alpha, beta = forward_rows(self._module, params, x)
         return alpha[..., 0], beta[..., 0]
 
+    # ------------------------------------------------- program-cache glue
+
+    def _cache_identity(self, b: int) -> tuple[str, dict]:
+        """(entry key, backend fingerprint) for bucket ``b``'s program.
+
+        The key covers everything that changes the compiled executable:
+        model spec, param leaf signature, window shape, bucket, and the
+        backend fingerprint (which includes the EXACT device ids — fleet
+        replicas own disjoint device subsets and must never load each
+        other's executables).
+        """
+        import dataclasses
+
+        from masters_thesis_tpu.serve import program_cache as pc
+        from masters_thesis_tpu.utils.backend_probe import backend_fingerprint
+
+        fp = backend_fingerprint(self.mesh)
+        ident = {
+            "spec": dataclasses.asdict(self.spec),
+            "params": pc.param_signature(self._params),
+            "window": list(self.window_shape),
+            "bucket": int(b),
+            "fingerprint": fp,
+        }
+        return pc.entry_key(ident), fp
+
+    def _golden_x(self, b: int) -> np.ndarray:
+        """Deterministic per-bucket parity input (seed varies by bucket so
+        each entry's golden data exercises its own executable shape)."""
+        return self.golden_batch(n=b, seed=1009 * b + 7)
+
+    def _cache_load(self, b: int, x_sh: NamedSharding, repl: NamedSharding):
+        """Try to boot bucket ``b`` from the program cache (None = miss)."""
+        key, fp = self._cache_identity(b)
+        treedef = jax.tree_util.tree_structure(self._params)
+        # Compiled.call trees for predict(params, x) -> (alpha, beta);
+        # 0 stands in for any array leaf.
+        in_tree = jax.tree_util.tree_structure(((self._params, 0), {}))
+        out_tree = jax.tree_util.tree_structure((0, 0))
+
+        def run_golden(compiled, golden):
+            n_leaves = sum(1 for k2 in golden if k2.startswith("param_"))
+            leaves = [golden[f"param_{i}"] for i in range(n_leaves)]
+            ptree = jax.tree_util.tree_unflatten(treedef, leaves)
+            pd = global_put(ptree, repl)
+            xd = jax.device_put(np.ascontiguousarray(golden["x"]), x_sh)
+            alpha, beta = compiled(pd, xd)
+            return (
+                np.asarray(jax.device_get(alpha)),
+                np.asarray(jax.device_get(beta)),
+            )
+
+        return self.program_cache.load(
+            key,
+            fingerprint=fp,
+            in_tree=in_tree,
+            out_tree=out_tree,
+            run_golden=run_golden,
+        )
+
+    def _cache_store(self, b: int, compiled, x_sh: NamedSharding) -> None:
+        """Serialize a freshly compiled bucket with its golden-parity data
+        (stored golden params = the CURRENT serving tree: future loads
+        verify against these stored values, not whatever tree is serving
+        then, so hot-swapped params don't invalidate parity)."""
+        key, fp = self._cache_identity(b)
+        x = self._golden_x(b)
+        xd = jax.device_put(np.ascontiguousarray(x), x_sh)
+        alpha, beta = compiled(self._params, xd)
+        host_leaves = jax.tree_util.tree_leaves(jax.device_get(self._params))
+        golden = {
+            "x": x,
+            "alpha": np.asarray(jax.device_get(alpha)),
+            "beta": np.asarray(jax.device_get(beta)),
+        }
+        for i, leaf in enumerate(host_leaves):
+            golden[f"param_{i}"] = np.asarray(leaf)
+        self.program_cache.store(key, compiled, fingerprint=fp, golden=golden)
+
     def _compile_bucket(self, b: int) -> None:
         k, t, f = self.window_shape
         repl = replicated_sharding(self.mesh)
@@ -126,15 +214,23 @@ class PredictEngine:
             x_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         else:
             x_sh = repl
-        jfn = jax.jit(
-            self._predict_fn,
-            in_shardings=(repl, x_sh),
-            out_shardings=(repl, repl),
-        )
-        x_struct = jax.ShapeDtypeStruct((b, k, t, f), jnp.float32)
-        compiled = jfn.lower(self._params, x_struct).compile()
+        compiled = None
+        if self.program_cache is not None:
+            compiled = self._cache_load(b, x_sh, repl)
+        if compiled is not None:
+            self.cache_hits += 1
+        else:
+            jfn = jax.jit(
+                self._predict_fn,
+                in_shardings=(repl, x_sh),
+                out_shardings=(repl, repl),
+            )
+            x_struct = jax.ShapeDtypeStruct((b, k, t, f), jnp.float32)
+            compiled = jfn.lower(self._params, x_struct).compile()
+            self.compile_events += 1
+            if self.program_cache is not None:
+                self._cache_store(b, compiled, x_sh)
         self._compiled[b] = (compiled, x_sh)
-        self.compile_events += 1
         try:
             from masters_thesis_tpu.telemetry.costs import extract_cost
 
@@ -254,6 +350,7 @@ class PredictEngine:
         n_features: int = 3,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         mesh: Mesh | None = None,
+        program_cache=None,
     ) -> "PredictEngine":
         """Boot an engine from a published checkpoint, STRICT verification:
         serving never starts from a tree whose content cannot be proven."""
@@ -286,4 +383,5 @@ class PredictEngine:
             n_features=n_features,
             buckets=buckets,
             mesh=mesh,
+            program_cache=program_cache,
         )

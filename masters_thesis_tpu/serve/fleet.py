@@ -1,0 +1,923 @@
+"""Self-healing serving fleet: N engine replicas behind one deadline queue.
+
+One engine on one mesh in one process (server.py) is the availability
+ceiling ROADMAP item 2 calls out: any single replica loss is an outage.
+This module grows that into a fleet —
+
+- **Replicas own device subsets.** Each :class:`Replica` wraps one
+  ``PredictEngine`` built by an injected factory (typically on a disjoint
+  slice of the local mesh — :func:`partition_meshes`), with its OWN EWMA
+  :class:`~masters_thesis_tpu.serve.queue.ServiceTimeModel`, its own
+  circuit breaker, and its own worker thread.
+- **Least-loaded dispatch.** The shared
+  :class:`~masters_thesis_tpu.serve.queue.MicroBatchQueue` feeds a
+  scheduler that assigns each micro-batch to the serving replica with the
+  smallest estimated completion (its EWMA x its backlog) — a
+  degraded-to-CPU replica keeps serving, it just stops winning batches.
+- **Per-replica admission.** The queue's ``feasibility`` hook sheds a
+  request at admit only when ALL serving replicas are infeasible for its
+  deadline; one slow replica cannot poison admission for healthy ones
+  (the satellite fix to the single global-model estimate).
+- **Evidence-based failure handling.** Dispatch errors feed the replica's
+  breaker (threshold trips buy ONE backend probe, then CPU degradation —
+  the PR 5 policy, per replica). A crash (``FaultInjected`` or any
+  unexpected exception), a hang (watchdog: ``busy_since`` stale), or a
+  boot failure declares the replica DEAD; the
+  :class:`~masters_thesis_tpu.resilience.supervisor.ReplicaRestartPolicy`
+  classifies the death (transient -> restart with backoff; identical
+  consecutive fingerprint or exhausted budget -> halt) and a restart
+  boots a fresh engine generation — warm from the shared
+  :class:`~masters_thesis_tpu.serve.program_cache.ProgramCache`, so a
+  replica resurrection costs milliseconds, not a compile burst.
+- **No late answers, fleet-wide.** A dead replica's in-flight and queued
+  batches are re-dispatched to survivors when their deadlines still
+  permit (span attribute ``redispatched_from`` marks the hop; the
+  request keeps ONE span whose components still tile). Anything
+  infeasible is explicitly shed/rejected — never silently dropped,
+  never delivered late.
+
+Jax-free at import (engines arrive via factories), so the selfcheck CLI
+can drive the whole failover state machine with fake engines on a host
+whose accelerator runtime is wedged.
+
+Fault points: ``serve.replica_dispatch`` (wedge -> device error feeding
+the breaker; corrupt/nan -> poisoned outputs; raise -> fatal crash; hang
+-> watchdog kill; match ``{"replica": name}`` to target one replica) and
+``serve.replica_boot`` (wedge/raise -> boot failure; the restart policy
+classifies the repeat).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue as stdqueue
+import re
+import threading
+import time
+
+import numpy as np
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
+from masters_thesis_tpu.serve.queue import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_REJECTED_LATE,
+    MicroBatchQueue,
+    PendingRequest,
+    ServeRequest,
+    ServeResponse,
+    ServiceTimeModel,
+)
+from masters_thesis_tpu.serve.server import InjectedDeviceError, shed_category
+from masters_thesis_tpu.serve.spans import RequestSpans
+from masters_thesis_tpu.utils.backend_probe import CircuitBreaker
+
+#: Replica health states (evidence-driven, see module docstring).
+STATE_LIVE = "live"
+STATE_DEGRADED = "degraded"
+STATE_DRAINING = "draining"
+STATE_DEAD = "dead"
+#: States that accept new batches.
+SERVING_STATES = (STATE_LIVE, STATE_DEGRADED)
+
+
+class ReplicaBootError(RuntimeError):
+    """A replica engine failed to boot (wedged lease, injected fault)."""
+
+
+def partition_meshes(n_replicas: int, devices=None) -> list:
+    """Split the local devices into ``n_replicas`` disjoint data meshes.
+
+    Lazy jax import — the only jax-touching helper in this module."""
+    import jax
+    from jax.sharding import Mesh
+
+    from masters_thesis_tpu.parallel import DATA_AXIS
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_replicas < 1 or n_replicas > len(devices):
+        raise ValueError(
+            f"cannot build {n_replicas} replicas from "
+            f"{len(devices)} devices"
+        )
+    per = len(devices) // n_replicas
+    return [
+        Mesh(
+            np.asarray(devices[i * per : (i + 1) * per]),
+            axis_names=(DATA_AXIS,),
+        )
+        for i in range(n_replicas)
+    ]
+
+
+class Replica:
+    """One engine slot: state + worker thread + its own load model."""
+
+    def __init__(self, name: str, engine_factory, breaker_threshold: int = 3):
+        self.name = name
+        self.engine_factory = engine_factory
+        self.engine = None
+        self.service_model = ServiceTimeModel()
+        self.breaker = CircuitBreaker(breaker_threshold)
+        self._breaker_threshold = breaker_threshold
+        self.state = STATE_DEAD  # not serving until booted
+        self.halted = False
+        self.generation = 0
+        self.inbox: stdqueue.Queue = stdqueue.Queue()
+        self.stop_event = threading.Event()
+        self.thread: threading.Thread | None = None
+        self.span = None
+        #: Set while a batch is on the device — the hang watchdog's clock.
+        self.busy_since: float | None = None
+        self.current_batch: list[PendingRequest] | None = None
+        self.completed = 0
+        self.errors = 0
+        self.busy_s = 0.0
+        self.boot_s: float | None = None
+
+    def backlog_estimate_s(self) -> float:
+        """Seconds until a batch assigned NOW would complete here."""
+        waiting = self.inbox.qsize() + (1 if self.busy_since else 0)
+        return (waiting + 1) * self.service_model.batch_s
+
+
+class FleetServer:
+    """Owns the queue, the scheduler, N replicas, and the failover policy.
+
+    ``engine_factories`` maps replica name -> zero-arg callable returning
+    a warmed-up-able engine; each (re)boot calls the factory fresh, so a
+    restart is a REAL re-instantiation (and, with a shared program cache,
+    a zero-compile one).
+    """
+
+    def __init__(
+        self,
+        engine_factories: dict,
+        *,
+        max_batch: int = 8,
+        max_wait_s: float = 0.005,
+        max_depth: int = 256,
+        telemetry=None,
+        health=None,
+        breaker_threshold: int = 3,
+        restart_policy: ReplicaRestartPolicy | None = None,
+        hang_timeout_s: float = 2.0,
+    ):
+        if not engine_factories:
+            raise ValueError("fleet needs at least one engine factory")
+        self.telemetry = telemetry
+        self.health = health
+        self.restart_policy = restart_policy or ReplicaRestartPolicy()
+        self.hang_timeout_s = hang_timeout_s
+        self.replicas: dict[str, Replica] = {
+            name: Replica(name, factory, breaker_threshold)
+            for name, factory in engine_factories.items()
+        }
+        self.queue = MicroBatchQueue(
+            max_batch=max_batch,
+            max_wait_s=max_wait_s,
+            max_depth=max_depth,
+            on_shed=self._on_shed,
+            feasibility=self._feasibility,
+        )
+        self.spans = RequestSpans(self._tracer)
+        self._lock = threading.RLock()
+        self._fleet_span = None
+        self._scheduler: threading.Thread | None = None
+        self._monitor: threading.Thread | None = None
+        self._boot_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._rid = 0
+        self._dispatch_seq = 0
+        self._started_ts: float | None = None
+        self._window_shape: tuple | None = None
+        self.completed = 0
+        self.errors = 0
+        self.late_converted = 0
+        #: ok responses delivered past deadline — 0 by construction.
+        self.late_deliveries = 0
+        self.degradations = 0
+        self.deaths = 0
+        self.redispatched = 0
+        self.shed_by_reason: dict[str, int] = {}
+
+    # ------------------------------------------------------------ telemetry
+
+    def _tracer(self):
+        return self.telemetry.tracer if self.telemetry is not None else None
+
+    def _event(self, kind: str, **payload) -> None:
+        if self.telemetry is not None:
+            self.telemetry.event(kind, **payload)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.telemetry is not None:
+            self.telemetry.counter(f"serve/{name}").inc(n)
+
+    def _observe_latency(self, latency_s: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.histogram("serve/latency_s").observe(latency_s)
+
+    # ------------------------------------------------------------ admission
+
+    def _serving(self) -> list[Replica]:
+        with self._lock:
+            return [
+                r for r in self.replicas.values()
+                if r.state in SERVING_STATES
+            ]
+
+    def _feasibility(self, request: ServeRequest, depth: int) -> str | None:
+        """Queue admission hook: shed only when EVERY serving replica's
+        own estimate misses the deadline (satellite fix: per-replica
+        models, not one global EWMA)."""
+        serving = self._serving()
+        if not serving:
+            return "no live replicas (fleet dead or halted)"
+        # Waiting queue depth spreads over the fleet; charge each replica
+        # its backlog plus an even share of the unassigned queue.
+        share = depth // max(1, len(serving) * self.queue.max_batch)
+        best = min(
+            r.backlog_estimate_s() + share * r.service_model.batch_s
+            for r in serving
+        )
+        now = time.monotonic()
+        if now + best > request.deadline_ts:
+            budget_ms = (request.deadline_ts - now) * 1e3
+            return (
+                f"deadline infeasible on ALL {len(serving)} serving "
+                f"replicas: best est {best * 1e3:.1f}ms > budget "
+                f"{budget_ms:.1f}ms at depth {depth}"
+            )
+        return None
+
+    def _on_shed(self, request: ServeRequest, reason: str) -> None:
+        self._count("shed")
+        category = shed_category(reason)
+        with self._lock:
+            self.shed_by_reason[category] = (
+                self.shed_by_reason.get(category, 0) + 1
+            )
+        self._event("request_shed", rid=request.rid, reason=reason)
+        self.spans.close_shed(request.rid, category)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._scheduler is not None:
+            raise RuntimeError("fleet already started")
+        tracer = self._tracer()
+        if tracer is not None:
+            self._fleet_span = tracer.start(
+                "serve.fleet", replicas=sorted(self.replicas)
+            )
+        for replica in self.replicas.values():
+            self._boot_replica(replica, initial=True)
+        serving = self._serving()
+        if not serving:
+            raise RuntimeError(
+                "fleet start failed: no replica survived boot"
+            )
+        # The fleet micro-batch can never exceed the smallest replica's
+        # largest bucket — any replica must be able to take any batch.
+        cap = min(r.engine.max_bucket for r in serving)
+        self.queue.max_batch = min(self.queue.max_batch, cap)
+        self._window_shape = tuple(serving[0].engine.window_shape)
+        self._started_ts = time.monotonic()
+        self._event(
+            "fleet_started",
+            replicas=sorted(self.replicas),
+            serving=[r.name for r in serving],
+            max_batch=self.queue.max_batch,
+        )
+        self._scheduler = threading.Thread(
+            target=self._scheduler_loop, name="fleet-scheduler", daemon=True
+        )
+        self._scheduler.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def stop(self) -> dict:
+        self.queue.close()
+        with self._lock:
+            for r in self.replicas.values():
+                if r.state in SERVING_STATES:
+                    r.state = STATE_DRAINING
+        if self._scheduler is not None:
+            self._scheduler.join(timeout=30.0)
+            self._scheduler = None
+        # An in-flight restart may be mid-compile; wait for it BEFORE the
+        # worker sentinels so its fresh worker receives one too (and so
+        # the interpreter never exits under a live XLA compile thread).
+        for t in list(self._boot_threads):
+            t.join(timeout=30.0)
+        for r in self.replicas.values():
+            if r.thread is not None:
+                r.inbox.put(None)  # drain sentinel
+                r.thread.join(timeout=30.0)
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        stats = self.stats()
+        tracer = self._tracer()
+        if tracer is not None:
+            for r in self.replicas.values():
+                if r.span is not None:
+                    tracer.end(
+                        r.span, status="ok",
+                        completed=r.completed, busy_s=r.busy_s,
+                    )
+                    r.span = None
+            if self._fleet_span is not None:
+                tracer.end(
+                    self._fleet_span, status="ok",
+                    requests=stats["requests"],
+                    completed=stats["completed"],
+                    shed=stats["shed"],
+                )
+                self._fleet_span = None
+        self._event("fleet_finished", **stats)
+        return stats
+
+    def stats(self) -> dict:
+        span = (
+            time.monotonic() - self._started_ts
+            if self._started_ts is not None
+            else 0.0
+        )
+        p50 = p99 = None
+        if self.telemetry is not None:
+            hist = self.telemetry.histogram("serve/latency_s")
+            p50, p99 = hist.quantile(0.5), hist.quantile(0.99)
+        queue_wait_share, compute_share = self.spans.shares()
+        with self._lock:
+            shed_by_reason = dict(self.shed_by_reason)
+            per_replica = {
+                r.name: {
+                    "state": r.state,
+                    "generation": r.generation,
+                    "restarts": self.restart_policy.restarts(r.name),
+                    "completed": r.completed,
+                    "errors": r.errors,
+                    "busy_s": r.busy_s,
+                    "utilization": r.busy_s / span if span > 0 else 0.0,
+                    "batch_ms": r.service_model.batch_s * 1e3,
+                    "boot_s": r.boot_s,
+                }
+                for r in self.replicas.values()
+            }
+        return {
+            "replicas": per_replica,
+            "n_live": sum(
+                1 for v in per_replica.values()
+                if v["state"] in SERVING_STATES
+            ),
+            "queue_wait_share": queue_wait_share,
+            "compute_share": compute_share,
+            "shed_by_reason": shed_by_reason,
+            "requests": self.queue.submitted,
+            "completed": self.completed,
+            "shed": self.queue.shed,
+            "errors": self.errors,
+            "late_converted": self.late_converted,
+            "late_deliveries": self.late_deliveries,
+            "degradations": self.degradations,
+            "deaths": self.deaths,
+            "redispatched": self.redispatched,
+            "p50_ms": None if p50 is None else p50 * 1e3,
+            "p99_ms": None if p99 is None else p99 * 1e3,
+            "qps": self.completed / span if span > 0 else 0.0,
+            "wall_s": span,
+        }
+
+    # -------------------------------------------------------------- request
+
+    def submit(self, x, deadline_s: float) -> PendingRequest:
+        x = np.asarray(x, np.float32)
+        if self._window_shape is None:
+            raise RuntimeError("fleet not started")
+        if x.shape != self._window_shape:
+            raise ValueError(
+                f"request window shape {x.shape} != engine window shape "
+                f"{self._window_shape}"
+            )
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+        self._count("requests")
+        # The span must exist BEFORE queue.submit: a shed resolves
+        # synchronously inside it, and _on_shed closes the span.
+        self.spans.open(
+            rid, "serve.request",
+            parent=self._fleet_span, deadline_ms=deadline_s * 1e3,
+        )
+        pending = self.queue.submit(
+            ServeRequest(
+                rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s
+            )
+        )
+        if not pending.done:
+            self.spans.stamp(rid, "t_admitted")
+        return pending
+
+    # ------------------------------------------------------------ scheduler
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            batch = self.queue.next_batch(timeout_s=0.05)
+            if not batch:
+                if self.queue.closed and len(self.queue) == 0:
+                    return
+                continue
+            self.spans.stamp_many(
+                [p.request.rid for p in batch], "t_pickup",
+                time.perf_counter(),
+            )
+            self._assign(batch)
+
+    def _pick_replica(self) -> Replica | None:
+        """Least-loaded serving replica by ITS OWN completion estimate."""
+        serving = self._serving()
+        if not serving:
+            return None
+        return min(serving, key=lambda r: r.backlog_estimate_s())
+
+    def _assign(self, batch: list[PendingRequest]) -> None:
+        target = self._pick_replica()
+        if target is None:
+            for p in batch:
+                if not p.done:
+                    self.queue._shed(
+                        p, "no live replicas (fleet dead or halted)"
+                    )
+            return
+        target.inbox.put(batch)
+
+    # --------------------------------------------------------------- worker
+
+    def _worker_loop(self, replica: Replica, generation: int) -> None:
+        while not replica.stop_event.is_set():
+            try:
+                batch = replica.inbox.get(timeout=0.05)
+            except stdqueue.Empty:
+                with self._lock:
+                    drained = (
+                        replica.state == STATE_DRAINING
+                        and replica.inbox.empty()
+                    )
+                if drained:
+                    return
+                continue
+            if batch is None:  # drain sentinel from stop()
+                return
+            with self._lock:
+                if replica.generation != generation:
+                    # A newer generation owns this replica; hand the work
+                    # back to the scheduler rather than racing it.
+                    self._assign([p for p in batch if not p.done])
+                    return
+                replica.busy_since = time.monotonic()
+                replica.current_batch = batch
+            try:
+                self._dispatch_on(replica, batch)
+            except BaseException as exc:  # noqa: BLE001 — fatal death
+                self._on_replica_crash(replica, exc)
+                return
+            finally:
+                with self._lock:
+                    replica.current_batch = None
+                    replica.busy_since = None
+
+    def _resolve(self, replica: Replica | None, pending: PendingRequest,
+                 status: str, detail: str = "",
+                 outputs: tuple | None = None) -> None:
+        now = time.monotonic()
+        t_resolve = time.perf_counter()
+        pending.resolve(
+            ServeResponse(
+                rid=pending.request.rid,
+                status=status,
+                outputs=outputs,
+                detail=detail,
+                delivered_ts=now,
+                latency_s=now - pending.request.submitted_ts,
+            )
+        )
+        self.spans.close(
+            pending.request.rid, status, t_resolve,
+            **({"replica": replica.name} if replica is not None else {}),
+        )
+
+    def _late_convert(self, replica: Replica | None,
+                      pending: PendingRequest, detail: str) -> None:
+        with self._lock:
+            self.late_converted += 1
+        self._count("late_converted")
+        self._resolve(replica, pending, STATUS_REJECTED_LATE, detail)
+
+    def _dispatch_on(self, replica: Replica,
+                     batch: list[PendingRequest]) -> None:
+        # Pre-dispatch feasibility recheck against THIS replica's model.
+        est = replica.service_model.batch_s
+        now = time.monotonic()
+        live = []
+        for p in batch:
+            if p.done:  # resolved elsewhere (shed/redispatch race)
+                continue
+            if now + est > p.request.deadline_ts:
+                self._late_convert(
+                    replica, p,
+                    "deadline infeasible at dispatch (queue wait consumed "
+                    "the budget); rejected rather than served late",
+                )
+            else:
+                live.append(p)
+        if not live:
+            return
+        with self._lock:
+            seq = self._dispatch_seq
+            self._dispatch_seq += 1
+        # Process kinds (raise -> fatal crash, hang -> watchdog) execute
+        # inside fire(); data kinds come back for us to apply.
+        kind = faults.fire(
+            "serve.replica_dispatch", replica=replica.name, seq=seq,
+            n=len(live),
+        )
+        tracer = self._tracer()
+        live_rids = [p.request.rid for p in live]
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        self.spans.stamp_many(live_rids, "t_predict0", t0)
+        try:
+            if kind == "wedge":
+                raise InjectedDeviceError(
+                    f"injected device error on {replica.name} seq={seq}"
+                )
+            xs = np.stack([p.request.x for p in live])
+            alpha, beta = replica.engine.predict(xs)
+            if kind in ("nan", "corrupt"):
+                alpha = np.full_like(alpha, np.nan)
+        except faults.FaultInjected:
+            raise  # fatal: the worker loop declares this replica dead
+        except Exception as exc:  # noqa: BLE001 — device/runtime error
+            self.spans.stamp_many(
+                live_rids, "t_predict_end", time.perf_counter()
+            )
+            with self._lock:
+                self.errors += len(live)
+                replica.errors += len(live)
+            self._count("errors", len(live))
+            for p in live:
+                self._resolve(
+                    replica, p, STATUS_ERROR,
+                    f"{type(exc).__name__}: {exc}",
+                )
+            if replica.breaker.record_failure():
+                self._degrade_replica(replica, exc)
+            return
+        device_s = time.perf_counter() - t0
+        self.spans.stamp_many(live_rids, "t_predict_end", t0 + device_s)
+        if tracer is not None:
+            tracer.emit_span(
+                "serve.device",
+                start_ts=t0_wall,
+                dur_s=device_s,
+                parent=replica.span or self._fleet_span,
+                seq=seq,
+                n=len(live),
+                replica=replica.name,
+            )
+        with self._lock:
+            replica.busy_s += device_s
+        replica.service_model.update(device_s)
+        replica.breaker.record_success()
+        self.restart_policy.note_healthy(replica.name)
+        finite = bool(
+            np.isfinite(alpha).all() and np.isfinite(beta).all()
+        )
+        now = time.monotonic()
+        for i, p in enumerate(live):
+            if not finite:
+                with self._lock:
+                    self.errors += 1
+                    replica.errors += 1
+                self._count("errors")
+                self._resolve(
+                    replica, p, STATUS_ERROR,
+                    "non-finite predictions; response withheld",
+                )
+            elif now > p.request.deadline_ts:
+                self._late_convert(
+                    replica, p,
+                    "batch completed past the deadline; rejected rather "
+                    "than delivered late",
+                )
+            else:
+                with self._lock:
+                    self.completed += 1
+                    replica.completed += 1
+                self._count("completed")
+                latency = now - p.request.submitted_ts
+                self._observe_latency(latency)
+                self._resolve(
+                    replica, p, STATUS_OK, outputs=(alpha[i], beta[i])
+                )
+                if time.monotonic() > p.request.deadline_ts:
+                    with self._lock:
+                        self.late_deliveries += 1
+                    self._count("late_deliveries")
+
+    # -------------------------------------------------------------- degrade
+
+    def _degrade_replica(self, replica: Replica, cause: Exception) -> None:
+        """Breaker tripped on ONE replica: one probe, then CPU rebuild of
+        that replica only — the rest of the fleet never notices."""
+        attempts = None
+        if self.health is not None:
+            decision = self.health.ensure_responsive(single_attempt=True)
+            attempts = decision.attempts
+            if decision.ok:
+                self._event(
+                    "breaker_probe_ok",
+                    replica=replica.name,
+                    trips=replica.breaker.trips,
+                    attempts=attempts,
+                    cause=repr(cause),
+                )
+                return
+        with self._lock:
+            self.degradations += 1
+        self._count("degradations")
+        replica.engine.degrade_to_cpu()
+        replica.service_model.seed(replica.engine.warmup())
+        with self._lock:
+            replica.state = STATE_DEGRADED
+        self._event(
+            "degradation",
+            scope="serve.replica",
+            replica=replica.name,
+            reason=f"circuit breaker tripped: {cause!r}",
+            probe_attempts=attempts,
+            platform=replica.engine.platform,
+        )
+
+    # ------------------------------------------------------------- failover
+
+    def _fingerprint(self, exc: BaseException) -> str:
+        # Digits are normalized out: a crash message embedding a sequence
+        # number / address must still fingerprint as the SAME failure, or
+        # the deterministic-by-evidence halt can never trigger.
+        norm = re.sub(r"\d+", "#", f"{type(exc).__name__}|{exc}")
+        return hashlib.sha1(norm.encode()).hexdigest()[:12]
+
+    def _on_replica_crash(self, replica: Replica, exc: BaseException) -> None:
+        self._declare_dead(
+            replica,
+            fingerprint=self._fingerprint(exc),
+            detail=f"{type(exc).__name__}: {exc}",
+            cause="crash",
+        )
+
+    def _declare_dead(self, replica: Replica, *, fingerprint: str,
+                      detail: str, cause: str) -> None:
+        with self._lock:
+            if replica.state == STATE_DEAD:
+                return
+            replica.state = STATE_DEAD
+            replica.stop_event.set()
+            generation = replica.generation
+            orphans: list[PendingRequest] = []
+            if replica.current_batch is not None:
+                orphans.extend(replica.current_batch)
+                replica.current_batch = None
+                replica.busy_since = None
+            while True:
+                try:
+                    batch = replica.inbox.get_nowait()
+                except stdqueue.Empty:
+                    break
+                if batch:
+                    orphans.extend(batch)
+            self.deaths += 1
+        self._count("replica_deaths")
+        self._event(
+            "replica_dead",
+            replica=replica.name,
+            generation=generation,
+            cause=cause,
+            fingerprint=fingerprint,
+            detail=detail,
+            orphaned=len(orphans),
+        )
+        tracer = self._tracer()
+        if tracer is not None and replica.span is not None:
+            tracer.end(
+                replica.span, status="dead", cause=cause,
+                completed=replica.completed, busy_s=replica.busy_s,
+            )
+            replica.span = None
+        self._redispatch(replica, orphans)
+        verdict = self.restart_policy.classify(
+            replica.name, fingerprint, detail
+        )
+        if verdict.action == "restart":
+            self._event(
+                "replica_restart_scheduled",
+                replica=replica.name,
+                backoff_s=verdict.backoff_s,
+                restarts=self.restart_policy.restarts(replica.name),
+            )
+            timer = threading.Thread(
+                target=self._delayed_boot,
+                args=(replica, verdict.backoff_s),
+                name=f"fleet-boot-{replica.name}",
+                daemon=True,
+            )
+            self._boot_threads.append(timer)
+            timer.start()
+        else:
+            with self._lock:
+                replica.halted = True
+            self._event(
+                "replica_halted",
+                replica=replica.name,
+                verdict=verdict.kind,
+                detail=verdict.detail,
+            )
+
+    def _redispatch(self, dead: Replica,
+                    orphans: list[PendingRequest]) -> None:
+        """Re-route a dead replica's unresolved work to survivors when
+        deadlines still permit; explicitly reject the rest. The request
+        keeps its ONE span — ``redispatched_from`` marks the hop."""
+        for p in orphans:
+            if p.done:
+                continue
+            target = None
+            now = time.monotonic()
+            serving = self._serving()
+            feasible = [
+                r for r in serving
+                if now + r.backlog_estimate_s() <= p.request.deadline_ts
+            ]
+            if feasible:
+                target = min(feasible, key=lambda r: r.backlog_estimate_s())
+            if target is None:
+                reason = (
+                    f"replica {dead.name} died; "
+                    + ("no live replica remains"
+                       if not serving else
+                       "no survivor can meet the deadline")
+                )
+                self._late_convert(None, p, reason)
+                continue
+            with self._lock:
+                self.redispatched += 1
+            self._count("redispatched")
+            self.spans.annotate(
+                p.request.rid, redispatched_from=dead.name
+            )
+            self._event(
+                "redispatch",
+                rid=p.request.rid,
+                from_replica=dead.name,
+                to_replica=target.name,
+            )
+            target.inbox.put([p])
+
+    def _delayed_boot(self, replica: Replica, backoff_s: float) -> None:
+        if backoff_s > 0:
+            time.sleep(backoff_s)
+        if self.queue.closed:
+            return
+        self._boot_replica(replica)
+
+    def _boot_replica(self, replica: Replica, initial: bool = False) -> None:
+        """(Re)build the replica's engine — a fresh generation. With a
+        shared program cache this is a zero-compile warm boot."""
+        with self._lock:
+            if replica.halted or (not initial and self.queue.closed):
+                return
+            replica.generation += 1
+            generation = replica.generation
+        try:
+            kind = faults.fire(
+                "serve.replica_boot",
+                replica=replica.name,
+                generation=generation,
+            )
+            if kind == "wedge":
+                raise ReplicaBootError(
+                    f"injected boot failure on {replica.name} "
+                    f"(wedged lease)"
+                )
+            t0 = time.perf_counter()
+            engine = replica.engine_factory()
+            warm_s = engine.warmup()
+            boot_s = time.perf_counter() - t0
+        except BaseException as exc:  # noqa: BLE001 — boot is fallible
+            fingerprint = f"boot:{self._fingerprint(exc)}"
+            detail = f"boot failed: {type(exc).__name__}: {exc}"
+            self._event(
+                "replica_boot_failed",
+                replica=replica.name,
+                generation=generation,
+                detail=detail,
+            )
+            verdict = self.restart_policy.classify(
+                replica.name, fingerprint, detail
+            )
+            if verdict.action == "restart" and not initial:
+                timer = threading.Thread(
+                    target=self._delayed_boot,
+                    args=(replica, verdict.backoff_s),
+                    name=f"fleet-boot-{replica.name}",
+                    daemon=True,
+                )
+                self._boot_threads.append(timer)
+                timer.start()
+            elif verdict.action == "restart" and initial:
+                # start() decides fleet viability from serving count;
+                # a failed initial boot retries once, inline.
+                time.sleep(verdict.backoff_s)
+                self._boot_replica(replica)
+            else:
+                with self._lock:
+                    replica.halted = True
+                    replica.state = STATE_DEAD
+                self._event(
+                    "replica_halted",
+                    replica=replica.name,
+                    verdict=verdict.kind,
+                    detail=verdict.detail,
+                )
+            return
+        with self._lock:
+            replica.engine = engine
+            replica.service_model.seed(warm_s)
+            replica.breaker = CircuitBreaker(replica._breaker_threshold)
+            replica.stop_event = threading.Event()
+            replica.current_batch = None
+            replica.busy_since = None
+            replica.boot_s = boot_s
+            replica.state = STATE_LIVE
+            replica.thread = threading.Thread(
+                target=self._worker_loop,
+                args=(replica, generation),
+                name=f"fleet-{replica.name}-g{generation}",
+                daemon=True,
+            )
+        tracer = self._tracer()
+        if tracer is not None:
+            replica.span = tracer.start(
+                "serve.replica",
+                parent=self._fleet_span,
+                replica=replica.name,
+                generation=generation,
+                platform=engine.platform,
+            )
+        self._event(
+            "replica_started",
+            replica=replica.name,
+            generation=generation,
+            restart=not initial,
+            boot_s=boot_s,
+            warmup_batch_ms=warm_s * 1e3,
+            compile_events=engine.compile_events,
+            cache_hits=getattr(engine, "cache_hits", 0),
+            platform=engine.platform,
+        )
+        replica.thread.start()
+
+    # -------------------------------------------------------------- monitor
+
+    def _monitor_loop(self) -> None:
+        """Hang watchdog: a replica stuck on one batch past
+        ``hang_timeout_s`` is dead by evidence (the same staleness rule as
+        the supervisor's heartbeat watchdog)."""
+        period = max(0.01, min(0.05, self.hang_timeout_s / 4.0))
+        while not self._stop.wait(period):
+            now = time.monotonic()
+            for replica in list(self.replicas.values()):
+                with self._lock:
+                    busy_since = replica.busy_since
+                    serving = replica.state in SERVING_STATES
+                if (
+                    serving
+                    and busy_since is not None
+                    and now - busy_since > self.hang_timeout_s
+                ):
+                    self._declare_dead(
+                        replica,
+                        fingerprint="hang",
+                        detail=(
+                            f"batch in flight for "
+                            f"{now - busy_since:.2f}s > hang timeout "
+                            f"{self.hang_timeout_s:.2f}s"
+                        ),
+                        cause="hang",
+                    )

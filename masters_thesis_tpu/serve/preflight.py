@@ -20,6 +20,17 @@ window of varied batch sizes and asserts the serving contract:
   memory, so an OOM-bound bucket config is refused here instead of at the
   first live request. Skipped (not failed) when the backend reports no
   budget (the virtual CPU mesh).
+- **SV305** — warm-cache boot: an engine booting against a program cache
+  another engine just populated performs ZERO compiles (measured through
+  the same ``CompileTracker`` accounting the telemetry uses), hits the
+  cache once per bucket, and produces bitwise-identical predictions to
+  the engine that stored the entries. A silent fallback to compiling —
+  or a deserialized program that computes differently — fails here, not
+  in production.
+- **SV306** — single-death survival: a small fleet with one replica
+  killed by an injected dispatch crash must keep >= 1 serving replica,
+  resolve every in-flight request explicitly (ok / shed /
+  rejected_late — zero silent drops), and deliver zero late answers.
 
 Sized to run in seconds on the 8-device virtual CPU mesh; the invariants
 are properties of the compiled programs, not of the backend.
@@ -166,6 +177,238 @@ def _run(spec, mesh, buckets, requests) -> list[Finding]:
                 rule="SV303",
                 message="preflight predictions are non-finite on random "
                 "inputs (engine wiring is broken)",
+            )
+        )
+    return findings
+
+
+def _preflight_spec():
+    from masters_thesis_tpu.models.objectives import ModelSpec
+
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        kernel_impl="xla",
+    )
+
+
+def _preflight_params(spec):
+    import jax
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    dummy = jnp.zeros(
+        (1, PREFLIGHT_LOOKBACK, PREFLIGHT_FEATURES), jnp.float32
+    )
+    return module.init(jax.random.key(0), dummy)["params"]
+
+
+def run_program_cache_preflight(
+    spec=None, mesh=None, buckets=(1, 2), cache_dir=None
+) -> list[Finding]:
+    """SV305 — warm program-cache boot performs zero compiles."""
+    try:
+        return _run_program_cache(spec, mesh, buckets, cache_dir)
+    except Exception as exc:  # noqa: BLE001 — SV303 carries the cause
+        return [
+            Finding(
+                rule="SV303",
+                message=f"program-cache preflight could not run: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+
+
+def _run_program_cache(spec, mesh, buckets, cache_dir) -> list[Finding]:
+    import tempfile
+
+    from masters_thesis_tpu.serve.engine import PredictEngine
+    from masters_thesis_tpu.serve.program_cache import ProgramCache
+    from masters_thesis_tpu.telemetry.run import CompileTracker
+
+    findings: list[Finding] = []
+    spec = spec or _preflight_spec()
+    params = _preflight_params(spec)
+
+    def build(cache):
+        return PredictEngine(
+            spec, params,
+            n_stocks=PREFLIGHT_STOCKS,
+            lookback=PREFLIGHT_LOOKBACK,
+            n_features=PREFLIGHT_FEATURES,
+            buckets=buckets,
+            mesh=mesh,
+            program_cache=cache,
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = cache_dir or tmp
+        cold = build(ProgramCache(root))
+        cold.warmup()
+        warm_cache = ProgramCache(root)
+        warm = build(warm_cache)
+        tracker = CompileTracker(warm)
+        warm.warmup()
+        delta = tracker.poll()
+        if delta != 0:
+            rejections = [
+                e for e in warm_cache.events
+                if e["kind"] == "cache_rejected"
+            ]
+            findings.append(
+                Finding(
+                    rule="SV305",
+                    message=f"warm-cache boot compiled {delta} "
+                    f"executable(s) for buckets {warm.buckets} (expected "
+                    f"0 — every program must load from the cache); "
+                    f"rejections: {rejections or 'none'}",
+                )
+            )
+        if warm.cache_hits != len(warm.buckets):
+            findings.append(
+                Finding(
+                    rule="SV305",
+                    message=f"warm-cache boot hit the cache "
+                    f"{warm.cache_hits} time(s) for {len(warm.buckets)} "
+                    f"buckets (expected one hit per bucket)",
+                )
+            )
+        x = cold.golden_batch(min(2, max(buckets)), seed=11)
+        a_cold, b_cold = cold.predict(x)
+        a_warm, b_warm = warm.predict(x)
+        if not (
+            np.array_equal(a_cold, a_warm)
+            and np.array_equal(b_cold, b_warm)
+        ):
+            findings.append(
+                Finding(
+                    rule="SV305",
+                    message="cache-loaded executables do not reproduce "
+                    "the storing engine's predictions bitwise — the "
+                    "deserialized program is not the program that was "
+                    "serialized",
+                )
+            )
+    return findings
+
+
+def run_fleet_preflight(
+    spec=None, n_replicas: int = 2, buckets=(1, 2), requests: int = 24
+) -> list[Finding]:
+    """SV306 — the fleet survives any single injected replica death."""
+    try:
+        return _run_fleet(spec, n_replicas, buckets, requests)
+    except Exception as exc:  # noqa: BLE001 — SV303 carries the cause
+        return [
+            Finding(
+                rule="SV303",
+                message=f"fleet preflight could not run: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+
+
+def _run_fleet(spec, n_replicas, buckets, requests) -> list[Finding]:
+    import time
+
+    from masters_thesis_tpu.resilience import faults
+    from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
+    from masters_thesis_tpu.serve.engine import PredictEngine
+    from masters_thesis_tpu.serve.fleet import FleetServer, partition_meshes
+
+    findings: list[Finding] = []
+    spec = spec or _preflight_spec()
+    params = _preflight_params(spec)
+    meshes = partition_meshes(n_replicas)
+
+    def factory_for(m):
+        return lambda: PredictEngine(
+            spec, params,
+            n_stocks=PREFLIGHT_STOCKS,
+            lookback=PREFLIGHT_LOOKBACK,
+            n_features=PREFLIGHT_FEATURES,
+            buckets=buckets,
+            mesh=m,
+        )
+
+    fleet = FleetServer(
+        {f"r{i}": factory_for(m) for i, m in enumerate(meshes)},
+        max_wait_s=0.003,
+        hang_timeout_s=2.0,
+        restart_policy=ReplicaRestartPolicy(backoff_s=0.01),
+    )
+    victim = "r0"
+    plan = faults.FaultPlan(
+        faults=[
+            faults.FaultSpec(
+                point="serve.replica_dispatch", kind="raise",
+                attempt=None, match={"replica": victim},
+            )
+        ]
+    )
+    rng = np.random.default_rng(0)
+    k, t, f = PREFLIGHT_STOCKS, PREFLIGHT_LOOKBACK, PREFLIGHT_FEATURES
+    try:
+        fleet.start()
+        faults.install_plan(plan)
+        pendings = [
+            fleet.submit(
+                rng.standard_normal((k, t, f)).astype(np.float32),
+                deadline_s=2.0,
+            )
+            for _ in range(requests)
+        ]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if fleet.replicas[victim].state == "dead":
+                break
+            time.sleep(0.01)
+        faults.clear_plan()
+        unresolved = 0
+        for p in pendings:
+            try:
+                p.result(timeout=10.0)
+            except TimeoutError:
+                unresolved += 1
+        # Capture liveness BEFORE stop(): draining is the shutdown state,
+        # not a failover outcome.
+        survivors = [r.name for r in fleet._serving()]
+        stats = fleet.stop()
+    finally:
+        faults.clear_plan()
+    if stats["deaths"] < 1:
+        findings.append(
+            Finding(
+                rule="SV306",
+                message="the injected dispatch crash never killed the "
+                f"victim replica ({victim}) — the preflight did not "
+                "exercise failover",
+            )
+        )
+    if not survivors:
+        findings.append(
+            Finding(
+                rule="SV306",
+                message=f"no serving replica survived a single injected "
+                f"replica death (states: "
+                f"{ {n: r['state'] for n, r in stats['replicas'].items()} })",
+            )
+        )
+    if unresolved:
+        findings.append(
+            Finding(
+                rule="SV306",
+                message=f"{unresolved} request(s) were silently dropped "
+                "after the replica death (every request must resolve "
+                "explicitly: ok, shed, or rejected_late)",
+            )
+        )
+    if stats["late_deliveries"]:
+        findings.append(
+            Finding(
+                rule="SV306",
+                message=f"{stats['late_deliveries']} ok response(s) "
+                "delivered past their deadline during failover (the "
+                "no-late-answers invariant must hold fleet-wide)",
             )
         )
     return findings

@@ -1,0 +1,345 @@
+"""On-disk cache of serialized serving executables: restart without compile.
+
+Rounds 3-6 established that cold compiles plus a wedged device lease are
+this environment's dominant serving tail risk (BENCH_r03-r05): an engine
+restart that recompiles every bucket is a multi-second availability hole,
+and a canaried hot-swap that needs a fresh engine pays it again. This
+module closes the hole: every bucketed ``Compiled`` predict program is
+serialized (``jax.experimental.serialize_executable`` — the PjRt
+executable payload, not just StableHLO) into a content-addressed on-disk
+entry, so the NEXT engine boot with the same program identity loads the
+executable instead of compiling it. Zero jit compiles, zero traces —
+preflight rule SV305 pins the delta through the existing
+``CompileTracker`` accounting.
+
+Trust model — a cache entry is evidence, never an oracle:
+
+- **Keyed on identity, not hope.** The entry key is a sha256 over the
+  model spec, the param-tree leaf signature (treedef + per-leaf
+  shape/dtype), the window shape, the bucket, the mesh (including the
+  EXACT device ids — a serialized executable is bound to its device
+  assignment, and loading r0's program onto r1's devices would silently
+  serve from the wrong replica's chips), and the backend fingerprint
+  (jax/jaxlib versions, platform, device kind, forced-host-device flag).
+  Anything that could change the compiled program changes the key.
+- **Torn entries are refused.** Every file is listed in a sha256
+  ``MANIFEST.json`` (same discipline as checkpoint manifests); a missing
+  file, mismatched hash, or unparseable manifest rejects the entry with a
+  ``cache_rejected`` event and the engine compiles fresh — a partial
+  write from a killed process must cost one compile, never a wrong
+  program.
+- **Stale entries are refused.** The manifest records the fingerprint the
+  entry was built under; if the current environment disagrees (jax
+  upgrade, different device kind), the entry is rejected as stale even
+  though its bytes are intact.
+- **Deserialization is verified, not trusted.** The entry stores a
+  deterministic golden input, the golden params it was serialized with,
+  and the outputs the ORIGINAL executable produced on them. At load, the
+  deserialized executable re-runs the golden batch and must reproduce
+  those outputs bitwise — the observed hazard where a deserialized
+  multi-device CPU executable computes ~0.7% differently from the program
+  that was serialized (see utils/compilation_cache.py) becomes a detected
+  refusal instead of a silently wrong answer.
+
+Fault point ``cache.load`` (kind ``corrupt``) flips a byte in the entry
+payload on disk before verification, so the chaos suite drives the real
+refusal machinery end to end.
+
+Import surface: numpy/stdlib only at module scope — jax loads lazily
+inside the (de)serialization paths, keeping ``serve``'s jax-free import
+contract intact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from masters_thesis_tpu.resilience import faults
+
+MANIFEST_NAME = "MANIFEST.json"
+#: Bump when the entry layout or key recipe changes: old entries become
+#: unreachable (different keys) instead of misread.
+CACHE_SCHEMA = 1
+
+
+def param_signature(tree: Any) -> dict:
+    """Stable identity of a param tree: treedef repr + per-leaf
+    (shape, dtype) in flatten order. Host- and device-tree agnostic."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return {
+        "treedef": str(treedef),
+        "leaves": [
+            [
+                list(np.shape(leaf)),
+                str(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype),
+            ]
+            for leaf in leaves
+        ],
+    }
+
+
+def entry_key(ident: dict) -> str:
+    """Content-addressed entry key: sha256 over the canonical JSON of the
+    full program identity (spec, signature, bucket, mesh devices,
+    backend fingerprint, schema)."""
+    canon = json.dumps(
+        {"schema": CACHE_SCHEMA, **ident}, sort_keys=True, default=str
+    )
+    return hashlib.sha256(canon.encode()).hexdigest()[:24]
+
+
+class ProgramCache:
+    """One cache root holding many entries; shared across engine replicas.
+
+    Layout::
+
+        <root>/MANIFEST.json           # {entries: {key: {files, fingerprint, ...}}}
+        <root>/<key>.bin               # serialized executable payload
+        <root>/<key>.golden.npz        # golden params/input/outputs for parity
+
+    Counters (``hits``/``misses``/``stores``/``rejections``) and the
+    ``events`` list make boot behavior auditable without telemetry; when
+    ``telemetry`` is attached every decision also lands in the event
+    stream (``cache_hit``/``cache_miss``/``cache_store``/
+    ``cache_rejected``).
+    """
+
+    def __init__(self, root: str | Path, telemetry=None):
+        self.root = Path(root)
+        self.telemetry = telemetry
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.rejections = 0
+        self.events: list[dict] = []
+
+    # ------------------------------------------------------------- plumbing
+
+    def _event(self, kind: str, **payload) -> None:
+        record = {"kind": kind, **payload}
+        self.events.append(record)
+        if self.telemetry is not None:
+            try:
+                self.telemetry.event(kind, **payload)
+            except Exception:  # cache accounting must never cost serving
+                pass
+
+    def _manifest_path(self) -> Path:
+        return self.root / MANIFEST_NAME
+
+    def _read_manifest(self) -> dict:
+        try:
+            raw = json.loads(self._manifest_path().read_text())
+        except (OSError, json.JSONDecodeError):
+            return {"schema": CACHE_SCHEMA, "entries": {}}
+        if not isinstance(raw, dict) or not isinstance(
+            raw.get("entries"), dict
+        ):
+            return {"schema": CACHE_SCHEMA, "entries": {}}
+        return raw
+
+    def _write_manifest(self, manifest: dict) -> None:
+        from masters_thesis_tpu.utils.io import atomic_write_text
+
+        self.root.mkdir(parents=True, exist_ok=True)
+        atomic_write_text(
+            self._manifest_path(), json.dumps(manifest, indent=2), fsync=True
+        )
+
+    def _remove_entry(self, key: str) -> None:
+        """Drop a refused entry so the rebuild can re-store cleanly."""
+        manifest = self._read_manifest()
+        manifest["entries"].pop(key, None)
+        try:
+            self._write_manifest(manifest)
+        except OSError:
+            pass
+        for suffix in (".bin", ".golden.npz"):
+            try:
+                (self.root / f"{key}{suffix}").unlink(missing_ok=True)
+            except OSError:
+                pass
+
+    def _reject(self, key: str, reason: str, detail: str = "") -> None:
+        self.rejections += 1
+        self._event("cache_rejected", key=key, reason=reason, detail=detail)
+        self._remove_entry(key)
+
+    # ----------------------------------------------------------------- load
+
+    def load(
+        self,
+        key: str,
+        *,
+        fingerprint: dict,
+        in_tree,
+        out_tree,
+        run_golden: Callable[[Any, dict], tuple] | None = None,
+    ):
+        """Return a loaded ``Compiled`` for ``key``, or ``None``.
+
+        ``None`` means miss OR refusal (torn/stale/corrupt/parity) — the
+        caller compiles fresh either way; refusals additionally emit
+        ``cache_rejected`` and delete the entry. ``run_golden(compiled,
+        golden)`` must execute the deserialized program on the entry's
+        stored golden params/input and return host (alpha, beta) arrays
+        for the bitwise parity check.
+        """
+        manifest = self._read_manifest()
+        entry = manifest["entries"].get(key)
+        if entry is None:
+            self.misses += 1
+            self._event("cache_miss", key=key)
+            return None
+        # Fault point: corrupt the payload ON DISK before verification so
+        # the refusal machinery below runs against a real torn entry.
+        if faults.fire("cache.load", key=key) == "corrupt":
+            self._corrupt_entry(key, seed=faults.corruption_seed())
+        if entry.get("fingerprint") != fingerprint:
+            self._reject(
+                key, "stale",
+                "entry fingerprint does not match the current backend "
+                f"(entry: {entry.get('fingerprint')!r})",
+            )
+            return None
+        files = entry.get("files")
+        if not isinstance(files, dict) or not files:
+            self._reject(key, "torn", "manifest entry lists no files")
+            return None
+        for name, want in files.items():
+            path = self.root / name
+            try:
+                blob = path.read_bytes()
+            except OSError:
+                self._reject(key, "torn", f"missing file {name}")
+                return None
+            if len(blob) != want.get("size") or (
+                hashlib.sha256(blob).hexdigest() != want.get("sha256")
+            ):
+                self._reject(key, "torn", f"sha256 mismatch on {name}")
+                return None
+        try:
+            compiled = self._deserialize(key, in_tree, out_tree)
+        except Exception as exc:  # noqa: BLE001 — any load failure refuses
+            self._reject(
+                key, "deserialize_failed", f"{type(exc).__name__}: {exc}"
+            )
+            return None
+        if run_golden is not None:
+            try:
+                golden = self._read_golden(key)
+                got_alpha, got_beta = run_golden(compiled, golden)
+                ok = np.array_equal(
+                    np.asarray(got_alpha), golden["alpha"]
+                ) and np.array_equal(np.asarray(got_beta), golden["beta"])
+            except Exception as exc:  # noqa: BLE001
+                self._reject(
+                    key, "golden_failed", f"{type(exc).__name__}: {exc}"
+                )
+                return None
+            if not ok:
+                self._reject(
+                    key, "golden_mismatch",
+                    "deserialized executable does not reproduce the stored "
+                    "golden outputs bitwise — the reload is not the program "
+                    "that was serialized (see utils/compilation_cache.py "
+                    "for the observed CPU-divergence hazard)",
+                )
+                return None
+        self.hits += 1
+        self._event("cache_hit", key=key)
+        return compiled
+
+    def _deserialize(self, key: str, in_tree, out_tree):
+        from jax.experimental import serialize_executable as se
+
+        payload = (self.root / f"{key}.bin").read_bytes()
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+
+    def _read_golden(self, key: str) -> dict:
+        with np.load(self.root / f"{key}.golden.npz") as z:
+            return {name: z[name] for name in z.files}
+
+    def _corrupt_entry(self, key: str, seed: int) -> None:
+        """Flip one byte of the payload (the chaos drill's torn write)."""
+        path = self.root / f"{key}.bin"
+        try:
+            blob = bytearray(path.read_bytes())
+        except OSError:
+            return
+        if not blob:
+            return
+        idx = seed % len(blob)
+        blob[idx] ^= 0xFF
+        path.write_bytes(bytes(blob))
+
+    # ---------------------------------------------------------------- store
+
+    def store(
+        self,
+        key: str,
+        compiled,
+        *,
+        fingerprint: dict,
+        golden: dict,
+    ) -> bool:
+        """Serialize ``compiled`` under ``key`` with its golden-parity data.
+
+        ``golden`` carries the flat golden param leaves (``param_<i>``),
+        the golden input (``x``), and the outputs the live executable
+        produced on them (``alpha``, ``beta``). Best-effort: a failed
+        store costs nothing but the warm start it would have bought.
+        """
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, _, _ = se.serialize(compiled)
+            self.root.mkdir(parents=True, exist_ok=True)
+            bin_path = self.root / f"{key}.bin"
+            golden_path = self.root / f"{key}.golden.npz"
+            bin_path.write_bytes(payload)
+            with golden_path.open("wb") as fh:
+                np.savez(fh, **golden)
+            files = {}
+            for path in (bin_path, golden_path):
+                blob = path.read_bytes()
+                files[path.name] = {
+                    "sha256": hashlib.sha256(blob).hexdigest(),
+                    "size": len(blob),
+                }
+            manifest = self._read_manifest()
+            manifest["entries"][key] = {
+                "files": files,
+                "fingerprint": fingerprint,
+                "created": time.time(),
+            }
+            self._write_manifest(manifest)
+        except Exception as exc:  # noqa: BLE001 — cache is an optimization
+            self._event(
+                "cache_store_failed",
+                key=key,
+                detail=f"{type(exc).__name__}: {exc}",
+            )
+            return False
+        self.stores += 1
+        self._event("cache_store", key=key)
+        return True
+
+    # ------------------------------------------------------------- summary
+
+    def stats(self) -> dict:
+        return {
+            "root": str(self.root),
+            "hits": self.hits,
+            "misses": self.misses,
+            "stores": self.stores,
+            "rejections": self.rejections,
+        }

@@ -142,6 +142,7 @@ class MicroBatchQueue:
         max_depth: int = 256,
         service_model: ServiceTimeModel | None = None,
         on_shed: Callable[[ServeRequest, str], None] | None = None,
+        feasibility: Callable[[ServeRequest, int], str | None] | None = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1: {max_batch}")
@@ -150,6 +151,14 @@ class MicroBatchQueue:
         self.max_depth = max_depth
         self.service_model = service_model or ServiceTimeModel()
         self.on_shed = on_shed
+        #: Optional admission override ``(request, depth) -> reason | None``.
+        #: The fleet installs one that consults each DISPATCHING replica's
+        #: own service-time model (shed only when ALL serving replicas are
+        #: infeasible) — a degraded-to-CPU replica's slow EWMA must not
+        #: poison admission for healthy replicas, and a single global model
+        #: cannot express that. ``None`` keeps the single-engine behavior:
+        #: the queue-wide ``service_model`` estimate.
+        self.feasibility = feasibility
         self._items: list[PendingRequest] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -191,15 +200,22 @@ class MicroBatchQueue:
             return self._shed(pending, "injected admission shed (fault)")
         if depth >= self.max_depth:
             return self._shed(pending, f"queue full (depth {depth})")
-        est = self.service_model.estimate_completion_s(depth, self.max_batch)
-        now = time.monotonic()
-        if now + est > request.deadline_ts:
-            budget_ms = (request.deadline_ts - now) * 1e3
-            return self._shed(
-                pending,
-                f"deadline infeasible: est {est * 1e3:.1f}ms > "
-                f"budget {budget_ms:.1f}ms at depth {depth}",
+        if self.feasibility is not None:
+            reason = self.feasibility(request, depth)
+            if reason is not None:
+                return self._shed(pending, reason)
+        else:
+            est = self.service_model.estimate_completion_s(
+                depth, self.max_batch
             )
+            now = time.monotonic()
+            if now + est > request.deadline_ts:
+                budget_ms = (request.deadline_ts - now) * 1e3
+                return self._shed(
+                    pending,
+                    f"deadline infeasible: est {est * 1e3:.1f}ms > "
+                    f"budget {budget_ms:.1f}ms at depth {depth}",
+                )
         with self._cond:
             if self._closed:  # re-check under the lock (close() raced us)
                 pass

@@ -45,6 +45,7 @@ from masters_thesis_tpu.serve.queue import (
     ServeResponse,
     ServiceTimeModel,
 )
+from masters_thesis_tpu.serve.spans import RequestSpans
 from masters_thesis_tpu.utils.backend_probe import CircuitBreaker
 
 
@@ -63,6 +64,10 @@ def shed_category(reason: str) -> str:
         return "queue_full"
     if reason.startswith("deadline infeasible"):
         return "deadline_infeasible"
+    if reason.startswith("no live replicas"):
+        return "no_live_replicas"
+    if reason.startswith("replica"):
+        return "replica_death"
     return "other"
 
 
@@ -110,16 +115,12 @@ class PredictServer:
         self.late_deliveries = 0
         self.degradations = 0
         self.shed_by_reason: dict[str, int] = {}
-        # Per-request trace state: rid -> {span, boundary stamps}. The
-        # boundaries (submit, admitted, batch pickup, predict start/end,
-        # resolve) tile each request's wall exactly, so the trace CLI's
+        # Per-request trace state (serve/spans.py): each request span's
+        # boundaries tile its wall exactly, so the trace CLI's
         # critical-path components sum to measured latency by construction.
         self._serve_span = None
-        self._req_trace: dict[int, dict] = {}
+        self.spans = RequestSpans(self._tracer)
         self._trace_lock = threading.Lock()
-        self._sum_queue_s = 0.0
-        self._sum_device_s = 0.0
-        self._sum_req_wall_s = 0.0
 
     # ------------------------------------------------------------ telemetry
 
@@ -137,47 +138,6 @@ class PredictServer:
 
     def _tracer(self):
         return self.telemetry.tracer if self.telemetry is not None else None
-
-    def _close_request_span(self, pending, status: str, t_resolve: float,
-                            **attrs) -> None:
-        """End a request span with components that tile its wall exactly.
-
-        Missing boundaries (e.g. a pre-dispatch rejection never reaches the
-        engine) collapse to zero-width components; boundaries are forced
-        monotone so a submit/pickup stamp race can't produce negatives.
-        """
-        tracer = self._tracer()
-        if tracer is None:
-            return
-        with self._trace_lock:
-            entry = self._req_trace.pop(pending.request.rid, None)
-        if entry is None:
-            return
-        b = [entry["t0"]]
-        for key in ("t_admitted", "t_pickup", "t_predict0", "t_predict_end"):
-            t = entry.get(key)
-            b.append(b[-1] if t is None else max(b[-1], t))
-        b.append(max(b[-1], t_resolve))
-        admit_s, queue_s, batch_form_s, device_s, deliver_s = (
-            b[i + 1] - b[i] for i in range(5)
-        )
-        wall = b[-1] - b[0]
-        if status == "ok":
-            with self._trace_lock:
-                self._sum_queue_s += queue_s
-                self._sum_device_s += device_s
-                self._sum_req_wall_s += wall
-        tracer.end(
-            entry["span"],
-            status=status,
-            dur_s=wall,
-            admit_s=admit_s,
-            queue_s=queue_s,
-            batch_form_s=batch_form_s,
-            device_s=device_s,
-            deliver_s=deliver_s,
-            **attrs,
-        )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -255,14 +215,8 @@ class PredictServer:
         if self.telemetry is not None:
             hist = self.telemetry.histogram("serve/latency_s")
             p50, p99 = hist.quantile(0.5), hist.quantile(0.99)
+        queue_wait_share, compute_share = self.spans.shares()
         with self._trace_lock:
-            wall_sum = self._sum_req_wall_s
-            queue_wait_share = (
-                self._sum_queue_s / wall_sum if wall_sum > 0 else None
-            )
-            compute_share = (
-                self._sum_device_s / wall_sum if wall_sum > 0 else None
-            )
             shed_by_reason = dict(self.shed_by_reason)
         return {
             "queue_wait_share": queue_wait_share,
@@ -295,31 +249,19 @@ class PredictServer:
             self._rid += 1
             rid = self._rid
         self._count("requests")
-        tracer = self._tracer()
-        if tracer is not None:
-            # The span must exist BEFORE queue.submit: a shed resolves
-            # synchronously inside it, and _on_shed closes the span.
-            entry = {
-                "span": tracer.start(
-                    "serve.request",
-                    parent=self._serve_span,
-                    rid=rid,
-                    deadline_ms=deadline_s * 1e3,
-                ),
-                "t0": time.perf_counter(),
-            }
-            with self._trace_lock:
-                self._req_trace[rid] = entry
+        # The span must exist BEFORE queue.submit: a shed resolves
+        # synchronously inside it, and _on_shed closes the span.
+        self.spans.open(
+            rid, "serve.request",
+            parent=self._serve_span, deadline_ms=deadline_s * 1e3,
+        )
         pending = self.queue.submit(
             ServeRequest(
                 rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s
             )
         )
-        if tracer is not None and not pending.done:
-            with self._trace_lock:
-                live = self._req_trace.get(rid)
-                if live is not None:
-                    live["t_admitted"] = time.perf_counter()
+        if not pending.done:
+            self.spans.stamp(rid, "t_admitted")
         return pending
 
     def _on_shed(self, request: ServeRequest, reason: str) -> None:
@@ -330,17 +272,7 @@ class PredictServer:
                 self.shed_by_reason.get(category, 0) + 1
             )
         self._event("request_shed", rid=request.rid, reason=reason)
-        tracer = self._tracer()
-        if tracer is not None:
-            with self._trace_lock:
-                entry = self._req_trace.pop(request.rid, None)
-            if entry is not None:
-                tracer.end(
-                    entry["span"],
-                    status="shed",
-                    reason_category=category,
-                    admit_s=time.perf_counter() - entry["t0"],
-                )
+        self.spans.close_shed(request.rid, category)
 
     # ------------------------------------------------------------- dispatch
 
@@ -351,13 +283,10 @@ class PredictServer:
                 if self.queue.closed and len(self.queue) == 0:
                     return
                 continue
-            if self._tracer() is not None:
-                t_pickup = time.perf_counter()
-                with self._trace_lock:
-                    for p in batch:
-                        entry = self._req_trace.get(p.request.rid)
-                        if entry is not None:
-                            entry["t_pickup"] = t_pickup
+            self.spans.stamp_many(
+                [p.request.rid for p in batch], "t_pickup",
+                time.perf_counter(),
+            )
             self._dispatch(batch)
 
     def _resolve(self, pending: PendingRequest, status: str, detail: str = "",
@@ -374,7 +303,7 @@ class PredictServer:
                 latency_s=now - pending.request.submitted_ts,
             )
         )
-        self._close_request_span(pending, status, t_resolve)
+        self.spans.close(pending.request.rid, status, t_resolve)
 
     def _dispatch(self, batch: list[PendingRequest]) -> None:
         # Pre-dispatch feasibility re-check: queue wait may have eaten a
@@ -402,15 +331,10 @@ class PredictServer:
         tracer = self._tracer()
         t0_wall = time.time()
         t0 = time.perf_counter()
+        live_rids = [p.request.rid for p in live]
 
         def stamp(key: str, t: float) -> None:
-            if tracer is None:
-                return
-            with self._trace_lock:
-                for p in live:
-                    entry = self._req_trace.get(p.request.rid)
-                    if entry is not None:
-                        entry[key] = t
+            self.spans.stamp_many(live_rids, key, t)
 
         stamp("t_predict0", t0)
         try:

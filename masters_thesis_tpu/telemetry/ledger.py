@@ -5,7 +5,8 @@ into a gated, queryable history: one schema-versioned record per measured
 bench point (git rev, backend, mesh shape, pack width, FLOPs, steps/s,
 utilization), appended by ``bench.py`` every run and diffed by
 ``python -m masters_thesis_tpu.telemetry ledger`` — which exits 2 when
-the latest round regresses steps/s or utilization by more than 15%
+the latest round regresses any gated metric (steps/s, utilization,
+cells/hour, serving knee QPS, or restart time) by more than 15%
 against the baseline window AT EQUAL CONFIG (same point, backend, mesh,
 batch size, pack width; a CPU-degraded round is never compared against a
 TPU baseline).
@@ -24,9 +25,21 @@ from pathlib import Path
 
 LEDGER_SCHEMA_VERSION = 1
 DEFAULT_LEDGER_PATH = Path("results") / "perf_ledger.jsonl"
-#: Regression gate: latest-round steps/s or utilization more than this
-#: far below the baseline median (at equal config) exits 2.
+#: Regression gate: a latest-round gated metric moving more than this
+#: far in its bad direction vs the baseline median (equal config) exits 2.
 REGRESSION_PCT = 15.0
+
+#: Gated metrics and their good direction: +1 = higher is better (a drop
+#: regresses), -1 = lower is better (a rise regresses — restart time).
+#: serve/knee_qps and serve/restart_s rows ride the same gate as the
+#: training throughput rows.
+GATED_METRICS = (
+    ("steps_per_sec", +1),
+    ("utilization_pct", +1),
+    ("cells_per_hour", +1),
+    ("knee_qps", +1),
+    ("restart_s", -1),
+)
 
 #: The fields that define "equal config" — a row is only ever compared
 #: against baseline rows agreeing on ALL of these.
@@ -167,9 +180,9 @@ def ledger_diff(
     MEDIAN over all earlier rounds' rows with the same config key (or the
     last ``baseline_rounds`` of them). A config with no baseline is
     reported as new, never as a regression. Exit semantics live in
-    ``report["regressed"]`` — True when any compared metric (steps/s,
-    utilization, or — for stacked points — cells/hour) dropped more than
-    ``threshold_pct``.
+    ``report["regressed"]`` — True when any ``GATED_METRICS`` entry moved
+    more than ``threshold_pct`` in its bad direction (a drop for
+    throughput-like metrics, a rise for restart time).
     """
     order = _round_order(rows)
     if not order:
@@ -208,7 +221,7 @@ def ledger_diff(
             "baseline_rounds": len({b.get("round") for b in baseline}),
         }
         regressed_metrics: list[str] = []
-        for metric in ("steps_per_sec", "utilization_pct", "cells_per_hour"):
+        for metric, direction in GATED_METRICS:
             latest_v = rec.get(metric)
             base_v = _median([b.get(metric) for b in baseline])
             row[metric] = {"latest": latest_v, "baseline": base_v}
@@ -216,7 +229,7 @@ def ledger_diff(
                 continue
             delta_pct = 100.0 * (latest_v - base_v) / base_v
             row[metric]["delta_pct"] = round(delta_pct, 2)
-            if delta_pct < -threshold_pct:
+            if direction * delta_pct < -threshold_pct:
                 regressed_metrics.append(metric)
         row["regressed_metrics"] = regressed_metrics
         compared.append(row)
@@ -278,13 +291,18 @@ def render_ledger_text(report: dict) -> str:
             f"{_fmt(util['baseline'], '.3f')}%"
             f" ({_fmt(util.get('delta_pct'), '+.1f')}%)"
         )
-        cph = row.get("cells_per_hour") or {}
-        if cph.get("latest") is not None:
-            line += (
-                f" | cells/h {_fmt(cph['latest'], '.1f')} vs "
-                f"{_fmt(cph['baseline'], '.1f')}"
-                f" ({_fmt(cph.get('delta_pct'), '+.1f')}%)"
-            )
+        for metric, label, spec in (
+            ("cells_per_hour", "cells/h", ".1f"),
+            ("knee_qps", "knee", ".1f"),
+            ("restart_s", "restart", ".3f"),
+        ):
+            m = row.get(metric) or {}
+            if m.get("latest") is not None:
+                line += (
+                    f" | {label} {_fmt(m['latest'], spec)} vs "
+                    f"{_fmt(m['baseline'], spec)}"
+                    f" ({_fmt(m.get('delta_pct'), '+.1f')}%)"
+                )
         lines.append(line + mark)
     for row in report["new_configs"]:
         lines.append(f"  {row['point']:<16s} new config (no baseline)")

@@ -126,6 +126,7 @@ def summarize_events(events: list[dict]) -> dict:
 
     restarts = _restart_stats(events, by_kind)
     serve = _serve_stats(by_kind)
+    fleet = _fleet_stats(by_kind)
     replicas = _replica_stats(by_kind)
     util = _utilization_stats(
         by_kind,
@@ -191,6 +192,7 @@ def summarize_events(events: list[dict]) -> dict:
         },
         "restarts": restarts,
         "serve": serve,
+        "fleet": fleet,
         "replicas": replicas,
         "utilization": util,
         "preflight": preflight.get("status"),
@@ -303,6 +305,76 @@ def _serve_stats(by_kind: dict) -> dict | None:
         "swaps_committed": swaps_committed,
         "swaps_rejected": swaps_rejected,
         "degradations": len(by_kind.get("degradation", [])),
+        "clean_stop": bool(finished),
+    }
+
+
+def _fleet_stats(by_kind: dict) -> dict | None:
+    """Serving-fleet accounting (serve/fleet.py): replica lifecycle,
+    failover, and exported-program cache behaviour. None for runs that
+    never ran a fleet or touched the program cache.
+
+    ``fleet_finished`` (stop()) is authoritative for the totals; the raw
+    lifecycle events (replica_dead / replica_started / redispatch /
+    cache_*) keep the section usable for a fleet that died before a
+    clean stop.
+    """
+    finished = by_kind.get("fleet_finished", [])
+    deaths = by_kind.get("replica_dead", [])
+    boots = by_kind.get("replica_started", [])
+    cache = {
+        "hits": len(by_kind.get("cache_hit", [])),
+        "misses": len(by_kind.get("cache_miss", [])),
+        "stores": len(by_kind.get("cache_store", [])),
+        "rejections": len(by_kind.get("cache_rejected", [])),
+    }
+    has_fleet = bool(finished or by_kind.get("fleet_started") or deaths
+                     or boots)
+    if not has_fleet and not any(cache.values()):
+        return None
+    last = finished[-1] if finished else {}
+    per = last.get("replicas") if isinstance(last.get("replicas"), dict) \
+        else {}
+    restart_boots = [b for b in boots if b.get("restart")]
+    return {
+        "replicas": sorted(per) or sorted(
+            {b.get("replica") for b in boots if b.get("replica")}
+        ),
+        "n_live": last.get("n_live"),
+        # stop() drains serving replicas before the final stats, so
+        # n_live is 0 at every clean stop by construction; draining means
+        # the replica was alive when the fleet shut down. Only dead /
+        # halted states count as losses.
+        "alive_at_stop": sum(
+            1 for rep in per.values()
+            if (rep or {}).get("state") in ("live", "degraded", "draining")
+        ),
+        "deaths": last.get("deaths", len(deaths)),
+        "death_causes": sorted(
+            {d.get("cause") for d in deaths if d.get("cause")}
+        ),
+        "restarts": len(restart_boots),
+        "halted": sorted({
+            h.get("replica")
+            for h in by_kind.get("replica_halted", [])
+            if h.get("replica")
+        }),
+        "redispatched": last.get(
+            "redispatched", len(by_kind.get("redispatch", []))
+        ),
+        "late_deliveries": last.get("late_deliveries"),
+        # Restart boots must come from the exported-program cache: a
+        # restarted replica that compiled anything took the cold path.
+        "restart_boot_compiles": sum(
+            int(b.get("compile_events") or 0) for b in restart_boots
+        ),
+        "restart_boot_cache_hits": sum(
+            int(b.get("cache_hits") or 0) for b in restart_boots
+        ),
+        "cache": cache,
+        "utilization": {
+            name: rep.get("utilization") for name, rep in per.items()
+        },
         "clean_stop": bool(finished),
     }
 
@@ -435,6 +507,37 @@ def contract_violations(report: dict) -> list[str]:
             "their deadline (contract: late answers are rejected, never "
             "delivered)"
         )
+    fleet = report.get("fleet")
+    if fleet:
+        if (
+            fleet.get("clean_stop")
+            and fleet.get("replicas")
+            and fleet.get("alive_at_stop") == 0
+        ):
+            violations.append(
+                "fleet: finished with ZERO live replicas (every replica "
+                "dead or halted — the fleet was serving explicit sheds, "
+                "not answers)"
+            )
+        if (fleet.get("late_deliveries") or 0) > 0:
+            violations.append(
+                f"fleet: {fleet['late_deliveries']} response(s) delivered "
+                "past their deadline during fleet serving (the no-late-"
+                "answers invariant must hold fleet-wide, failover included)"
+            )
+        # Only gate restart compiles when a program cache was actually in
+        # play (cache events in-stream, or restart boots reporting hits):
+        # a cacheless fleet legitimately recompiles on restart.
+        cache_active = any((fleet.get("cache") or {}).values()) or (
+            fleet.get("restart_boot_cache_hits") or 0
+        ) > 0
+        if cache_active and (fleet.get("restart_boot_compiles") or 0) > 0:
+            violations.append(
+                f"fleet: restarted replica(s) compiled "
+                f"{fleet['restart_boot_compiles']} program(s) at boot "
+                "(contract: restarts load from the exported-program cache "
+                "with zero compiles)"
+            )
     util = report.get("utilization")
     if util and (report.get("platform") or "").lower() == "tpu":
         pct = util.get("flops_utilization_pct")
@@ -517,6 +620,25 @@ def render_text(report: dict) -> str:
             f"swaps {sv.get('swaps_committed', 0)}+/"
             f"{sv.get('swaps_rejected', 0)}-, "
             f"{sv.get('degradations', 0)} degradation(s)",
+        )
+    fl = report.get("fleet")
+    if fl:
+        cache = fl.get("cache") or {}
+        util_bits = ", ".join(
+            f"{name} {_fmt(u, '.2f')}"
+            for name, u in sorted((fl.get("utilization") or {}).items())
+        )
+        lines.insert(
+            len(lines) - 1,
+            f"fleet          : {len(fl.get('replicas') or [])} replica(s), "
+            f"{fl.get('deaths') or 0} death(s), "
+            f"{fl.get('restarts') or 0} restart(s), "
+            f"{fl.get('redispatched') or 0} redispatched, "
+            f"halted {fl.get('halted') or 'none'} | "
+            f"cache {cache.get('hits', 0)} hit(s) / "
+            f"{cache.get('stores', 0)} store(s) / "
+            f"{cache.get('rejections', 0)} rejection(s)"
+            + (f" | util {util_bits}" if util_bits else ""),
         )
     util = report.get("utilization")
     if util is not None:

@@ -185,6 +185,44 @@ class BackendHealth:
         )
 
 
+def backend_fingerprint(mesh=None) -> dict:
+    """Identity of the compiled-program environment, for cache keying.
+
+    The serving program cache (serve/program_cache.py) refuses any entry
+    whose fingerprint disagrees with the booting process: a serialized
+    executable is only meaningful under the jax/jaxlib pair, backend
+    platform, device kind, and device set it was compiled for — and on
+    the forced-multi-device host platform reloads have been observed to
+    diverge numerically, so that flag is part of the identity too.
+    Requires a live backend (callers hold a mesh already); never probes.
+    """
+    import os as _os
+
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except ImportError:  # pragma: no cover - jaxlib rides with jax
+        jaxlib_version = None
+    devices = list(mesh.devices.flat) if mesh is not None else jax.devices()
+    first = devices[0] if devices else None
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "platform": (
+            first.platform if first is not None else jax.default_backend()
+        ),
+        "device_kind": getattr(first, "device_kind", None),
+        "device_ids": [int(d.id) for d in devices],
+        "forced_host_devices": (
+            "--xla_force_host_platform_device_count"
+            in _os.environ.get("XLA_FLAGS", "")
+        ),
+    }
+
+
 class CircuitBreaker:
     """Consecutive-failure breaker in front of :class:`BackendHealth`.
 

@@ -1,0 +1,704 @@
+"""Serving fleet + exported-program cache (ISSUE 13).
+
+Three layers, cheapest first:
+
+- jax-free fleet state machine: fake engines drive dispatch, per-replica
+  admission, failover (crash / hang / deterministic halt), and the
+  explicit no-live-replicas shed — no backend, milliseconds per test.
+- ProgramCache: round-trip, torn/stale/injected-corruption refusal, key
+  identity — real engines on the 8-device virtual CPU mesh (conftest).
+- chaos: a real 2-replica fleet with a shared cache loses a replica at
+  load; survivors absorb the work (zero late, zero silent drops) and the
+  resurrection boots warm from the cache with ZERO compiles.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.faults import FaultPlan, FaultSpec
+from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
+from masters_thesis_tpu.serve.fleet import (
+    STATE_DEAD,
+    STATE_LIVE,
+    FleetServer,
+)
+from masters_thesis_tpu.serve.queue import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_REJECTED_LATE,
+    STATUS_SHED,
+    MicroBatchQueue,
+    ServeRequest,
+)
+
+K, T, F = 4, 8, 3
+CACHE_BUCKETS = (1, 2)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults(monkeypatch):
+    """Every test starts and ends with injection off, whatever it does."""
+    monkeypatch.delenv(faults.FAULT_PLAN_ENV, raising=False)
+    monkeypatch.delenv(faults.ATTEMPT_ENV, raising=False)
+    yield
+    faults.clear_plan()
+
+
+# ------------------------------------------------------------ fake engines
+
+
+class FakeEngine:
+    """Engine-protocol stand-in: configurable service time, no jax."""
+
+    def __init__(self, service_s: float = 0.001, buckets=(1, 2, 4)):
+        self.service_s = service_s
+        self.buckets = tuple(buckets)
+        self.window_shape = (2, 3, 1)
+        self.max_bucket = self.buckets[-1]
+        self.compile_events = len(self.buckets)
+        self.cache_hits = 0
+        self.platform = "fake"
+        self.predicted = 0
+
+    def warmup(self) -> float:
+        return self.service_s
+
+    def predict(self, x, params=None):
+        time.sleep(self.service_s)
+        self.predicted += x.shape[0]
+        n, k = x.shape[0], self.window_shape[0]
+        return (
+            np.zeros((n, k), np.float32),
+            np.zeros((n, k), np.float32),
+        )
+
+    def degrade_to_cpu(self) -> None:
+        pass
+
+
+def _fake_fleet(n=3, service_s=0.001, **kwargs):
+    kwargs.setdefault("max_wait_s", 0.002)
+    kwargs.setdefault(
+        "restart_policy", ReplicaRestartPolicy(backoff_s=0.01)
+    )
+    if not isinstance(service_s, (list, tuple)):
+        service_s = [service_s] * n
+    factories = {
+        f"r{i}": (lambda s=s: FakeEngine(service_s=s))
+        for i, s in enumerate(service_s)
+    }
+    return FleetServer(factories, **kwargs)
+
+
+def _window():
+    return np.zeros((2, 3, 1), np.float32)
+
+
+def _wait_for(cond, timeout=8.0, period=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(period)
+    return False
+
+
+# ------------------------------------------------- fleet dispatch (jax-free)
+
+
+def test_fleet_serves_across_replicas():
+    fleet = _fake_fleet(n=3)
+    fleet.start()
+    try:
+        pend = [fleet.submit(_window(), deadline_s=5.0) for _ in range(40)]
+        results = [p.result(timeout=10.0) for p in pend]
+    finally:
+        stats = fleet.stop()
+    assert all(r.status == STATUS_OK for r in results)
+    assert stats["late_deliveries"] == 0
+    # stop() drains serving replicas; draining (not dead) means every
+    # replica was alive to the end.
+    assert all(
+        r["state"] == "draining" for r in stats["replicas"].values()
+    )
+    assert sum(r["completed"] for r in stats["replicas"].values()) == 40
+
+
+def test_least_loaded_dispatch_prefers_fast_replica():
+    # r0 is 50x slower; the backlog estimate should route almost all
+    # batches to r1 (a degraded/slow replica keeps serving, it just
+    # stops winning work).
+    fleet = _fake_fleet(n=2, service_s=[0.05, 0.001])
+    fleet.start()
+    try:
+        pend = [fleet.submit(_window(), deadline_s=5.0) for _ in range(30)]
+        for p in pend:
+            assert p.result(timeout=10.0).status == STATUS_OK
+    finally:
+        stats = fleet.stop()
+    assert (
+        stats["replicas"]["r1"]["completed"]
+        > stats["replicas"]["r0"]["completed"]
+    )
+
+
+def test_admission_uses_best_replica_not_worst():
+    # Deadline feasible only on the fast replica: a fleet that admitted
+    # on a global (or worst-replica) estimate would shed everything.
+    slow_only = _fake_fleet(n=1, service_s=[0.2])
+    slow_only.start()
+    try:
+        r = slow_only.submit(_window(), deadline_s=0.05).result(timeout=5.0)
+        assert r.status == STATUS_SHED
+    finally:
+        slow_only.stop()
+
+    mixed = _fake_fleet(n=2, service_s=[0.2, 0.001])
+    mixed.start()
+    try:
+        results = [
+            mixed.submit(_window(), deadline_s=0.05).result(timeout=5.0)
+            for _ in range(10)
+        ]
+    finally:
+        stats = mixed.stop()
+    assert all(r.status == STATUS_OK for r in results)
+    assert stats["replicas"]["r1"]["completed"] == 10
+
+
+def test_queue_feasibility_hook_sheds_with_reason():
+    q = MicroBatchQueue(feasibility=lambda req, depth: "too slow today")
+    pending = q.submit(
+        ServeRequest(rid=1, x=None, deadline_ts=time.monotonic() + 1.0)
+    )
+    assert pending.done
+    response = pending.result(timeout=1.0)
+    assert response.status == STATUS_SHED
+    assert "too slow today" in response.detail
+    q.close()
+
+
+# ---------------------------------------------------- failover (jax-free)
+
+
+def test_replica_crash_redispatches_then_restarts():
+    fleet = _fake_fleet(n=2)
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_dispatch", kind="raise", attempt=1,
+        match={"replica": "r0"},
+    )])
+    fleet.start()
+    try:
+        faults.install_plan(plan)
+        pend = [fleet.submit(_window(), deadline_s=5.0) for _ in range(30)]
+        assert _wait_for(lambda: fleet.deaths >= 1)
+        faults.clear_plan()
+        results = [p.result(timeout=10.0) for p in pend]
+        # One death, every request still resolved explicitly, no lates.
+        assert all(
+            r.status in (STATUS_OK, STATUS_SHED, STATUS_REJECTED_LATE)
+            for r in results
+        )
+        assert _wait_for(lambda: fleet.replicas["r0"].generation >= 2)
+        assert _wait_for(
+            lambda: fleet.replicas["r0"].state == STATE_LIVE
+        )
+    finally:
+        stats = fleet.stop()
+    assert stats["deaths"] >= 1
+    assert stats["late_deliveries"] == 0
+    assert stats["replicas"]["r0"]["restarts"] >= 1
+
+
+def test_hang_watchdog_declares_replica_dead():
+    fleet = _fake_fleet(n=2, hang_timeout_s=0.3)
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_dispatch", kind="hang", attempt=1,
+        match={"replica": "r1"},
+    )])
+    fleet.start()
+    try:
+        faults.install_plan(plan)
+        pend = [fleet.submit(_window(), deadline_s=5.0) for _ in range(20)]
+        assert _wait_for(lambda: fleet.deaths >= 1)
+        faults.clear_plan()
+        for p in pend:
+            r = p.result(timeout=10.0)
+            assert r.status in (STATUS_OK, STATUS_SHED, STATUS_REJECTED_LATE)
+        assert _wait_for(lambda: fleet.replicas["r1"].generation >= 2)
+    finally:
+        stats = fleet.stop()
+    assert stats["deaths"] >= 1
+    assert stats["late_deliveries"] == 0
+
+
+def test_repeated_identical_crash_halts_deterministically():
+    fleet = _fake_fleet(n=2)
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_dispatch", kind="raise", attempt=None,
+        match={"replica": "r0"},
+    )])
+    fleet.start()
+    try:
+        faults.install_plan(plan)
+        halted = False
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not halted:
+            p = fleet.submit(_window(), deadline_s=2.0)
+            p.result(timeout=5.0)
+            halted = fleet.replicas["r0"].halted
+        assert halted, "identical crash fingerprints never halted r0"
+        faults.clear_plan()
+        # The survivor keeps serving after the halt.
+        r = fleet.submit(_window(), deadline_s=5.0).result(timeout=5.0)
+        assert r.status == STATUS_OK
+    finally:
+        stats = fleet.stop()
+    assert fleet.replicas["r0"].state == STATE_DEAD
+    assert stats["replicas"]["r1"]["state"] in ("live", "draining")
+
+
+def test_all_replicas_dead_sheds_explicitly():
+    fleet = _fake_fleet(
+        n=1, restart_policy=ReplicaRestartPolicy(max_restarts=0),
+    )
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_dispatch", kind="raise", attempt=1,
+        match={"replica": "r0"},
+    )])
+    fleet.start()
+    try:
+        faults.install_plan(plan)
+        fleet.submit(_window(), deadline_s=2.0).result(timeout=5.0)
+        assert _wait_for(lambda: fleet.replicas["r0"].halted)
+        faults.clear_plan()
+        r = fleet.submit(_window(), deadline_s=2.0).result(timeout=5.0)
+        assert r.status == STATUS_SHED
+        assert "no live replicas" in r.detail
+    finally:
+        stats = fleet.stop()
+    assert stats["shed_by_reason"].get("no_live_replicas", 0) >= 1
+
+
+def test_injected_corruption_errors_but_replica_stays_live():
+    fleet = _fake_fleet(n=2)
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_dispatch", kind="nan", attempt=1,
+    )])
+    fleet.start()
+    try:
+        faults.install_plan(plan)
+        poisoned = fleet.submit(_window(), deadline_s=5.0).result(
+            timeout=5.0
+        )
+        faults.clear_plan()
+        clean = [
+            fleet.submit(_window(), deadline_s=5.0).result(timeout=5.0)
+            for _ in range(6)
+        ]
+    finally:
+        stats = fleet.stop()
+    assert poisoned.status == STATUS_ERROR  # refused, not served
+    assert all(r.status == STATUS_OK for r in clean)
+    assert stats["deaths"] == 0  # bad output is not a crash
+    assert stats["errors"] >= 1
+
+
+def test_boot_fault_then_successful_retry():
+    fleet = _fake_fleet(n=2)
+    # Wedge ONLY generation 1: boot faults match on the attempt context,
+    # so the inline retry (generation 2) comes up clean.
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_boot", kind="wedge", attempt=1,
+        match={"replica": "r0", "generation": 1},
+    )])
+    faults.install_plan(plan)
+    try:
+        fleet.start()  # initial boot retries inline after the wedge
+        faults.clear_plan()
+        assert fleet.replicas["r0"].state == STATE_LIVE
+        r = fleet.submit(_window(), deadline_s=5.0).result(timeout=5.0)
+        assert r.status == STATUS_OK
+    finally:
+        fleet.stop()
+
+
+# ------------------------------------------------------------ program cache
+
+
+def _tiny_spec():
+    from masters_thesis_tpu.models.objectives import ModelSpec
+
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        kernel_impl="xla",
+    )
+
+
+def _init_params(spec, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    return module.init(
+        jax.random.key(seed), jnp.zeros((1, T, F), jnp.float32)
+    )["params"]
+
+
+def _cached_engine(cache, seed=0, buckets=CACHE_BUCKETS):
+    from masters_thesis_tpu.serve.engine import PredictEngine
+
+    spec = _tiny_spec()
+    return PredictEngine(
+        spec, _init_params(spec, seed),
+        n_stocks=K, lookback=T, n_features=F, buckets=buckets,
+        program_cache=cache,
+    )
+
+
+def _rejections(cache, reason=None):
+    evs = [e for e in cache.events if e["kind"] == "cache_rejected"]
+    return [e for e in evs if reason is None or e["reason"] == reason]
+
+
+def test_program_cache_round_trip_zero_compiles(tmp_path):
+    from masters_thesis_tpu.serve.program_cache import ProgramCache
+
+    cold_cache = ProgramCache(tmp_path)
+    cold = _cached_engine(cold_cache)
+    cold.warmup()
+    assert cold.compile_events == len(CACHE_BUCKETS)
+    assert cold_cache.stores == len(CACHE_BUCKETS)
+
+    warm_cache = ProgramCache(tmp_path)
+    warm = _cached_engine(warm_cache)
+    warm.warmup()
+    assert warm.compile_events == 0
+    assert warm.cache_hits == len(CACHE_BUCKETS)
+    assert warm_cache.hits == len(CACHE_BUCKETS)
+
+    x = cold.golden_batch(2, seed=123)
+    a0, b0 = cold.predict(x)
+    a1, b1 = warm.predict(x)
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(b0), np.asarray(b1))
+
+
+def test_program_cache_refuses_torn_entry(tmp_path):
+    from masters_thesis_tpu.serve.program_cache import ProgramCache
+
+    cold = _cached_engine(ProgramCache(tmp_path))
+    cold.warmup()
+    victim = next(tmp_path.glob("*.bin"))
+    blob = bytearray(victim.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+
+    cache = ProgramCache(tmp_path)
+    eng = _cached_engine(cache)
+    eng.warmup()
+    assert cache.rejections >= 1
+    assert _rejections(cache, "torn")
+    # The torn bucket compiled fresh; the intact one still hit.
+    assert eng.compile_events >= 1
+    assert eng.compile_events + eng.cache_hits == len(CACHE_BUCKETS)
+    x = cold.golden_batch(2, seed=7)
+    np.testing.assert_array_equal(
+        np.asarray(cold.predict(x)[0]), np.asarray(eng.predict(x)[0])
+    )
+
+
+def test_program_cache_refuses_stale_fingerprint(tmp_path):
+    from masters_thesis_tpu.serve.program_cache import ProgramCache
+
+    _cached_engine(ProgramCache(tmp_path)).warmup()
+    manifest_path = tmp_path / "MANIFEST.json"
+    manifest = json.loads(manifest_path.read_text())
+    for entry in manifest["entries"].values():
+        entry["fingerprint"]["jaxlib"] = "some-other-build"
+    manifest_path.write_text(json.dumps(manifest))
+
+    cache = ProgramCache(tmp_path)
+    eng = _cached_engine(cache)
+    eng.warmup()
+    assert cache.hits == 0
+    assert len(_rejections(cache, "stale")) == len(CACHE_BUCKETS)
+    assert eng.compile_events == len(CACHE_BUCKETS)
+
+
+def test_program_cache_fault_point_corrupts_then_refuses(tmp_path):
+    from masters_thesis_tpu.serve.program_cache import ProgramCache
+
+    _cached_engine(ProgramCache(tmp_path)).warmup()
+    plan = FaultPlan(faults=[FaultSpec(
+        point="cache.load", kind="corrupt", attempt=1,
+    )])
+    faults.install_plan(plan)
+    try:
+        cache = ProgramCache(tmp_path)
+        eng = _cached_engine(cache)
+        eng.warmup()
+    finally:
+        faults.clear_plan()
+    assert cache.rejections >= 1
+    assert _rejections(cache, "torn")
+    assert eng.compile_events >= 1  # the corrupted entry compiled fresh
+
+
+def test_entry_key_tracks_identity(tmp_path):
+    from masters_thesis_tpu.serve.program_cache import entry_key
+
+    base = {
+        "spec": {"objective": "mse"}, "params": {"n": 1},
+        "window": [4, 8, 3], "bucket": 2,
+        "fingerprint": {"jaxlib": "x", "device_ids": [0]},
+    }
+    k0 = entry_key(base)
+    assert k0 == entry_key(dict(base))  # deterministic
+    for field, value in (
+        ("bucket", 4),
+        ("window", [4, 8, 4]),
+        ("fingerprint", {"jaxlib": "x", "device_ids": [1]}),
+        ("params", {"n": 2}),
+    ):
+        assert entry_key({**base, field: value}) != k0
+
+
+# ----------------------------------------------------------- chaos (real)
+
+
+def test_fleet_kill_at_load_survives_and_resurrects_warm(tmp_path):
+    """The acceptance drill: kill a replica mid-load. Survivors absorb
+    the work (zero late, zero silent drops), the request spans record the
+    cross-replica hop, and the resurrection boots from the shared
+    program cache with ZERO compiles."""
+    from masters_thesis_tpu.serve.engine import PredictEngine
+    from masters_thesis_tpu.serve.fleet import partition_meshes
+    from masters_thesis_tpu.serve.program_cache import ProgramCache
+    from masters_thesis_tpu.telemetry import TelemetryRun
+
+    spec = _tiny_spec()
+    params = _init_params(spec)
+    cache = ProgramCache(tmp_path / "cache")
+    meshes = partition_meshes(2)
+
+    def factory_for(m):
+        return lambda: PredictEngine(
+            spec, params, n_stocks=K, lookback=T, n_features=F,
+            buckets=CACHE_BUCKETS, mesh=m, program_cache=cache,
+        )
+
+    tel = TelemetryRun(tmp_path / "tel", run_id="fleet-chaos")
+    fleet = FleetServer(
+        {f"r{i}": factory_for(m) for i, m in enumerate(meshes)},
+        telemetry=tel, max_wait_s=0.003,
+        restart_policy=ReplicaRestartPolicy(backoff_s=0.01),
+    )
+    plan = FaultPlan(faults=[FaultSpec(
+        point="serve.replica_dispatch", kind="raise", attempt=1,
+        match={"replica": "r0"},
+    )])
+    rng = np.random.default_rng(0)
+    fleet.start()
+    try:
+        faults.install_plan(plan)
+        pend = [
+            fleet.submit(
+                rng.standard_normal((K, T, F)).astype(np.float32),
+                deadline_s=3.0,
+            )
+            for _ in range(24)
+        ]
+        assert _wait_for(lambda: fleet.deaths >= 1, timeout=15.0)
+        faults.clear_plan()
+        results = [p.result(timeout=20.0) for p in pend]
+        assert _wait_for(
+            lambda: fleet.replicas["r0"].generation >= 2, timeout=15.0
+        )
+        assert _wait_for(
+            lambda: fleet.replicas["r0"].state == STATE_LIVE, timeout=15.0
+        )
+        resurrected = fleet.replicas["r0"].engine
+        # Drive the resurrected replica: post-restart traffic must land
+        # on BOTH replicas (proof r0 is really back in rotation).
+        assert _wait_for(
+            lambda: (
+                fleet.submit(
+                    rng.standard_normal((K, T, F)).astype(np.float32),
+                    deadline_s=3.0,
+                ).result(timeout=10.0).ok
+                and fleet.replicas["r0"].completed > 0
+            ),
+            timeout=15.0,
+        )
+    finally:
+        stats = fleet.stop()
+        tel.close()
+        faults.clear_plan()
+
+    # Zero silent drops, zero late answers, at least one explicit death.
+    assert all(
+        r.status in (STATUS_OK, STATUS_SHED, STATUS_REJECTED_LATE)
+        for r in results
+    )
+    assert stats["late_deliveries"] == 0
+    assert stats["deaths"] >= 1
+    # The resurrection was warm: programs came from the shared cache.
+    assert resurrected.compile_events == 0
+    assert resurrected.cache_hits == len(CACHE_BUCKETS)
+
+    # The trace stream shows the failover: a redispatched request span
+    # and device spans on BOTH replicas.
+    from masters_thesis_tpu.telemetry.report import resolve_events_path
+
+    events = [
+        json.loads(line)
+        for line in Path(
+            resolve_events_path(tmp_path / "tel")
+        ).read_text().splitlines()
+        if line.strip()
+    ]
+    spans = [e for e in events if e.get("kind") == "span"]
+    hops = [
+        s for s in spans
+        if (s.get("attrs") or {}).get("redispatched_from") == "r0"
+    ]
+    device_replicas = {
+        (s.get("attrs") or {}).get("replica")
+        for s in spans if s.get("name") == "serve.device"
+    }
+    redispatch_events = [
+        e for e in events if e.get("kind") == "redispatch"
+    ]
+    assert hops or redispatch_events
+    assert {"r0", "r1"} <= device_replicas
+
+
+def test_preflight_sv305_sv306_clean():
+    from masters_thesis_tpu.serve.preflight import (
+        run_fleet_preflight,
+        run_program_cache_preflight,
+    )
+
+    assert run_program_cache_preflight() == []
+    assert run_fleet_preflight() == []
+
+
+# ------------------------------------------------------- ledger + report
+
+
+def _ledger_row(round_id, point, **extra):
+    from masters_thesis_tpu.telemetry.ledger import ledger_record
+
+    return ledger_record(
+        point=point, round_id=round_id, platform="cpu",
+        steps_per_sec=None, objective="mse", rev="test", **extra,
+    )
+
+
+def test_ledger_gates_knee_qps_drop():
+    from masters_thesis_tpu.telemetry.ledger import ledger_diff
+
+    rows = [
+        _ledger_row("r1", "serve/knee_qps", knee_qps=100.0),
+        _ledger_row("r2", "serve/knee_qps", knee_qps=50.0),
+    ]
+    report = ledger_diff(rows)
+    assert report["regressed"]
+    assert report["regressions"][0]["regressed_metrics"] == ["knee_qps"]
+
+    rows_up = [
+        _ledger_row("r1", "serve/knee_qps", knee_qps=100.0),
+        _ledger_row("r2", "serve/knee_qps", knee_qps=120.0),
+    ]
+    assert not ledger_diff(rows_up)["regressed"]
+
+
+def test_ledger_gates_restart_time_rise():
+    from masters_thesis_tpu.telemetry.ledger import ledger_diff
+
+    worse = [
+        _ledger_row("r1", "serve/restart_s", restart_s=1.0),
+        _ledger_row("r2", "serve/restart_s", restart_s=2.0),
+    ]
+    report = ledger_diff(worse)
+    assert report["regressed"]
+    assert report["regressions"][0]["regressed_metrics"] == ["restart_s"]
+
+    better = [
+        _ledger_row("r1", "serve/restart_s", restart_s=2.0),
+        _ledger_row("r2", "serve/restart_s", restart_s=1.0),
+    ]
+    assert not ledger_diff(better)["regressed"]
+
+
+def test_ledger_render_shows_serving_metrics():
+    from masters_thesis_tpu.telemetry.ledger import (
+        ledger_diff,
+        render_ledger_text,
+    )
+
+    rows = [
+        _ledger_row("r1", "serve/knee_qps", knee_qps=100.0),
+        _ledger_row("r1", "serve/restart_s", restart_s=0.5),
+        _ledger_row("r2", "serve/knee_qps", knee_qps=99.0),
+        _ledger_row("r2", "serve/restart_s", restart_s=0.51),
+    ]
+    report = ledger_diff(rows)
+    report["path"] = "x"
+    text = render_ledger_text(report)
+    assert "knee 99.0 vs 100.0" in text
+    assert "restart 0.510 vs 0.500" in text
+    assert not report["regressed"]
+
+
+def test_report_fleet_section_and_contracts():
+    from masters_thesis_tpu.telemetry.report import summarize_events
+
+    ok_events = [
+        {"kind": "fleet_started", "replicas": ["r0", "r1"]},
+        {"kind": "replica_started", "replica": "r0", "restart": False,
+         "compile_events": 2, "cache_hits": 0},
+        {"kind": "replica_dead", "replica": "r0", "cause": "crash"},
+        {"kind": "replica_started", "replica": "r0", "restart": True,
+         "compile_events": 0, "cache_hits": 2},
+        {"kind": "cache_hit", "key": "k"},
+        {"kind": "fleet_finished", "replicas": {
+            "r0": {"state": "draining", "utilization": 0.4},
+            "r1": {"state": "draining", "utilization": 0.5}},
+         "n_live": 0, "deaths": 1, "late_deliveries": 0,
+         "redispatched": 3},
+    ]
+    report = summarize_events(ok_events)
+    fleet = report["fleet"]
+    assert fleet["deaths"] == 1
+    assert fleet["restarts"] == 1
+    assert fleet["redispatched"] == 3
+    assert fleet["cache"]["hits"] == 1
+    assert not any(v.startswith("fleet:") for v in report["violations"])
+
+    # Every replica dead/halted at a clean stop is a contract violation
+    # (draining is the normal shutdown state, not a loss).
+    dead_events = [
+        {"kind": "fleet_finished", "replicas": {
+            "r0": {"state": "dead"}, "r1": {"state": "dead"}},
+         "n_live": 0, "deaths": 2, "late_deliveries": 0},
+    ]
+    violations = summarize_events(dead_events)["violations"]
+    assert any("ZERO live replicas" in v for v in violations)
+
+    # A restart that compiled despite an active cache is a violation.
+    cold_restart = [
+        {"kind": "replica_started", "replica": "r0", "restart": True,
+         "compile_events": 2, "cache_hits": 1},
+        {"kind": "fleet_finished", "replicas": {
+            "r0": {"state": "draining"}}, "n_live": 0,
+         "deaths": 1, "late_deliveries": 0},
+    ]
+    violations = summarize_events(cold_restart)["violations"]
+    assert any("exported-program cache" in v for v in violations)
