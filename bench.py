@@ -1266,10 +1266,31 @@ def main() -> None:
             print("--telemetry-dir needs a path argument", file=sys.stderr)
             sys.exit(2)
     if "--preflight" in sys.argv:
-        # Gate the benchmark on the tracelint trace-time audit: a recompile
-        # / transfer / sharding regression makes every number below
-        # meaningless, so fail loudly before burning the measurement budget.
+        # Gate the benchmark on the static Pass-3 lints first (jax-free,
+        # sub-second): a lock-order inversion or unguarded counter in the
+        # serving stack corrupts the very numbers this run exists to
+        # produce, and an event-schema drift breaks the summarize tooling
+        # that reads them.
+        import masters_thesis_tpu
+        from masters_thesis_tpu.analysis.concurrency import lint_concurrency
+        from masters_thesis_tpu.analysis.contracts import lint_contracts
         from masters_thesis_tpu.analysis.findings import format_report
+
+        pkg_root = Path(masters_thesis_tpu.__file__).parent
+        static = lint_concurrency([pkg_root], package_root=pkg_root)
+        static += lint_contracts(
+            [pkg_root],
+            package_root=pkg_root,
+            schema_path=pkg_root / "analysis" / "event_schema.json",
+        )
+        if static:
+            print(format_report(static), file=sys.stderr)
+            sys.exit(2)
+        print("preflight: concurrency + contract lint ok", file=sys.stderr)
+
+        # Then the tracelint trace-time audit: a recompile / transfer /
+        # sharding regression makes every number below meaningless, so
+        # fail loudly before burning the measurement budget.
         from masters_thesis_tpu.analysis.traceaudit import run_trace_audit
 
         # stacked_replicas=3 also audits the stacked program (TA207: one

@@ -2,7 +2,13 @@
 
 Pass 1 (AST lint) over the given paths (default: the installed package),
 then Pass 2 (trace-time audit) on a hermetic 8-device virtual CPU mesh.
-Exits non-zero iff there are findings, so it gates CI (tools/check.sh).
+Pass 3 — static concurrency lint (``--concurrency``, CL5xx) and the
+event-schema contract check (``--contracts``, EC6xx) — is opt-in from
+this CLI and gated by tools/check.sh; passing either flag runs *only*
+the requested Pass-3 checks (jax never imports, so it is fast enough
+for a pre-commit hook). ``--emit-schema`` regenerates the
+``analysis/event_schema.json`` lockfile. Exits non-zero iff there are
+findings, so every mode gates CI.
 """
 
 from __future__ import annotations
@@ -77,12 +83,73 @@ def main(argv: list[str] | None = None) -> int:
         help="replica count for the stacked-program audit (TA207); "
         "0 skips it",
     )
+    parser.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="run only the Pass-3 concurrency lint (CL501-CL505)",
+    )
+    parser.add_argument(
+        "--contracts",
+        action="store_true",
+        help="run only the Pass-3 event-schema contract check "
+        "(EC601-EC603; checks the lockfile when linting the package)",
+    )
+    parser.add_argument(
+        "--emit-schema",
+        action="store_true",
+        help="regenerate analysis/event_schema.json from the emitter "
+        "sites and exit (0 on success)",
+    )
     args = parser.parse_args(argv)
 
     import masters_thesis_tpu
 
     package_root = Path(masters_thesis_tpu.__file__).parent
     paths = args.paths or [package_root]
+    lockfile = package_root / "analysis" / "event_schema.json"
+
+    if args.emit_schema:
+        import json
+
+        from masters_thesis_tpu.analysis.contracts import build_schema
+
+        schema = build_schema(paths, package_root=package_root)
+        lockfile.write_text(json.dumps(schema, indent=2) + "\n")
+        print(
+            f"wrote {lockfile} "
+            f"({len(schema['kinds'])} event kinds)"
+        )
+        return 0
+
+    if args.concurrency or args.contracts:
+        pass3: list = []
+        if args.concurrency:
+            from masters_thesis_tpu.analysis.concurrency import (
+                lint_concurrency,
+            )
+
+            pass3.extend(
+                lint_concurrency(paths, package_root=package_root)
+            )
+        if args.contracts:
+            from masters_thesis_tpu.analysis.contracts import (
+                lint_contracts,
+            )
+
+            pass3.extend(
+                lint_contracts(
+                    paths,
+                    package_root=package_root,
+                    schema_path=lockfile if not args.paths else None,
+                )
+            )
+        from masters_thesis_tpu.analysis.findings import format_report
+
+        pass3 = sorted(
+            set(pass3), key=lambda f: (f.path, f.line, f.rule, f.message)
+        )
+        print(format_report(pass3, as_json=args.json))
+        return 1 if pass3 else 0
 
     findings = []
     if not args.skip_lint:

@@ -1,7 +1,19 @@
 """Finding records, rule registry, per-line suppression, and reporting.
 
-Every rule — AST (``TL1xx``) and trace-time (``TA2xx``) — registers here so
-the CLI, the docs, and the suppression parser share one source of truth.
+Every rule — AST (``TL1xx``), trace-time (``TA2xx``), serve preflight
+(``SV3xx``), cost (``CP4xx``), concurrency (``CL5xx``), and event contract
+(``EC6xx``) — registers here so the CLI, the docs, and the suppression
+parser share one source of truth.
+
+Suppression syntax is unified across every pass. The canonical spelling::
+
+    self._beats += 1  # mtt: disable=CL502 -- single-writer heartbeat counter
+
+requires a justification after ``--``; a rule-bearing suppression without
+one still suppresses (so a migration never *adds* noise) but is itself
+reported as ``SP001`` by the gate. The legacy ``# tracelint: disable=...``
+spelling and ``# noqa: TLxxx`` remain parsed for back-compat and ruff
+interop; a bare ``# noqa`` never swallows findings.
 """
 
 from __future__ import annotations
@@ -98,10 +110,72 @@ RULES: dict[str, tuple[str, str]] = {
         "playbook)",
         "utilization",
     ),
+    # CL5xx: host-side concurrency lint (analysis/concurrency.py) — the
+    # threaded serving/telemetry stack, where the hazard is a deadlock or
+    # a torn read rather than a retrace.
+    "CL501": (
+        "lock-order inversion: a cycle in the acquires-while-holding graph "
+        "— two code paths take the same locks in opposite orders",
+        "concurrency / deadlock",
+    ),
+    "CL502": (
+        "unguarded shared state: an attribute of a thread-shared object is "
+        "mutated (read-modify-write) or accessed without the lock that "
+        "guards its other accesses",
+        "concurrency / race",
+    ),
+    "CL503": (
+        "blocking call under a held lock (I/O, subprocess, time.sleep, "
+        "queue waits, device compute) — every other thread contending on "
+        "the lock stalls for the duration",
+        "concurrency / latency",
+    ),
+    "CL504": (
+        "non-signal-safe work in signal-handler-reachable code (blocking "
+        "lock acquire, sleep, join, wait) — Python handlers run on the "
+        "main thread, so a blocking acquire of a lock the interrupted "
+        "frame holds is a self-deadlock",
+        "concurrency / deadlock",
+    ),
+    "CL505": (
+        "thread lifecycle: a non-daemon thread that is never joined, or a "
+        "thread spawned in __init__ with no stop/join path on the class",
+        "concurrency / lifecycle",
+    ),
+    # EC6xx: event-stream contract (analysis/contracts.py) — emitters
+    # (EventSink.emit / TelemetryRun.event / _event wrappers / emit_span)
+    # versus the jax-free readers (report/aggregate/trace/ledger).
+    "EC601": (
+        "event field consumed by a reader but never emitted under that "
+        "kind by any emitter site",
+        "contract",
+    ),
+    "EC602": (
+        "emitter/reader type disagreement for an event field (e.g. a "
+        "reader casts to float a field only ever emitted as str)",
+        "contract",
+    ),
+    "EC603": (
+        "event schema drift: the emitted-event inventory no longer "
+        "matches analysis/event_schema.json (regenerate with "
+        "--emit-schema and review the diff)",
+        "contract",
+    ),
+    # SP0xx: suppression hygiene (enforced by the Pass-3 file scan).
+    "SP001": (
+        "suppression without justification: '# mtt: disable=<RULE>' "
+        "requires a reason after ' -- '",
+        "hygiene",
+    ),
 }
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*(?:tracelint:\s*disable|noqa:?)\s*(?:=\s*)?(?P<ids>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)?"
+_DISABLE_RE = re.compile(
+    r"#\s*(?P<spelling>mtt|tracelint):\s*disable"
+    r"(?:\s*=\s*(?P<ids>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*))?"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$"
+)
+_NOQA_RE = re.compile(
+    r"#\s*noqa:?\s*(?:=\s*)?(?P<ids>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)?"
 )
 
 
@@ -117,29 +191,95 @@ class Finding:
         return f"{loc}: {self.rule} {self.message}"
 
 
-def suppressed_rules_by_line(source: str) -> dict[int, set[str] | None]:
-    """Map 1-based line number -> suppressed rule ids (None = all rules).
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    """One parsed per-line suppression comment."""
 
-    Recognises ``# tracelint: disable=TL101`` (per-rule, comma-separable),
-    ``# tracelint: disable`` (whole line), and ``# noqa: TL101`` for
-    composition with standard linting.
+    line: int
+    rules: frozenset[str] | None  # None = every rule on this line
+    reason: str | None
+    spelling: str  # "mtt" | "tracelint" | "noqa"
+
+
+def parse_suppressions(source: str) -> list[Suppression]:
+    """The ONE suppression parser shared by every pass (TL/TA/CL/EC).
+
+    Recognises, in priority order on each line:
+
+    - ``# mtt: disable=CL502 -- reason`` — canonical; comma-separable
+      rule list; the reason is mandatory (``SP001`` otherwise).
+    - ``# tracelint: disable[=TL101]`` — legacy alias, same semantics
+      (a missing reason is still ``SP001``); bare form disables all
+      rules on the line.
+    - ``# noqa: TL103`` — ruff/flake8 interop; only with explicit rule
+      ids (a bare ``# noqa`` never swallows findings).
     """
-    out: dict[int, set[str] | None] = {}
+    out: list[Suppression] = []
     for lineno, text in enumerate(source.splitlines(), start=1):
         if "#" not in text:
             continue
-        m = _SUPPRESS_RE.search(text)
-        if not m:
+        m = _DISABLE_RE.search(text)
+        if m is not None:
+            ids = m.group("ids")
+            rules = (
+                frozenset(p.strip() for p in ids.split(","))
+                if ids is not None
+                else None
+            )
+            out.append(
+                Suppression(
+                    line=lineno,
+                    rules=rules,
+                    reason=m.group("reason"),
+                    spelling=m.group("spelling"),
+                )
+            )
             continue
-        ids = m.group("ids")
-        # A bare "# noqa" (no rule list) from standard linting should not
-        # silently swallow tracelint findings unless it is the tracelint
-        # spelling.
-        if ids is None:
-            if "tracelint" in text:
-                out[lineno] = None
-            continue
-        out[lineno] = {part.strip() for part in ids.split(",")}
+        m = _NOQA_RE.search(text)
+        if m is not None and m.group("ids") is not None:
+            out.append(
+                Suppression(
+                    line=lineno,
+                    rules=frozenset(
+                        p.strip() for p in m.group("ids").split(",")
+                    ),
+                    reason=None,
+                    spelling="noqa",
+                )
+            )
+    return out
+
+
+def suppressed_rules_by_line(source: str) -> dict[int, set[str] | None]:
+    """Map 1-based line number -> suppressed rule ids (None = all rules)."""
+    out: dict[int, set[str] | None] = {}
+    for sup in parse_suppressions(source):
+        out[sup.line] = None if sup.rules is None else set(sup.rules)
+    return out
+
+
+def suppression_findings(source: str, path: str) -> list[Finding]:
+    """``SP001`` for every mtt/tracelint suppression lacking a reason.
+
+    Emitted by the Pass-3 file scan (concurrency.py) so the gate sees it
+    exactly once per line; ``noqa`` spellings are ruff's jurisdiction and
+    exempt. The reason-less suppression still *works* — the gate fails on
+    the hygiene finding instead of surprising the author with the
+    original rule re-firing.
+    """
+    out = []
+    for sup in parse_suppressions(source):
+        if sup.spelling in ("mtt", "tracelint") and not sup.reason:
+            rules = ",".join(sorted(sup.rules)) if sup.rules else "<all>"
+            out.append(
+                Finding(
+                    "SP001",
+                    f"suppression of {rules} has no reason — write "
+                    "'# mtt: disable=<RULE> -- <why this is safe>'",
+                    path,
+                    sup.line,
+                )
+            )
     return out
 
 

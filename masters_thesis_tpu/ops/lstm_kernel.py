@@ -1500,18 +1500,18 @@ def lstm_stack_recurrence(
     # config, never tracers — the taint analysis only flags them because
     # cost profiling (telemetry/costs.py lstm_route_cost) jits this
     # dispatcher directly, making its params look trace-reachable.
-    if impl == "auto":  # tracelint: disable=TL102
+    if impl == "auto":  # mtt: disable=TL102 -- impl is static host config, not a tracer; only cost profiling jits this dispatcher
         impl = (
             "xla"
             if os.environ.get("MT_TPU_DISABLE_PALLAS")
-            else ("pallas" if jax.default_backend() == "tpu" else "xla")  # tracelint: disable=TL102
+            else ("pallas" if jax.default_backend() == "tpu" else "xla")  # mtt: disable=TL102 -- backend name is host-side config, never traced
         )
     ell = len(w_hh_ts)
     n_t, batch = x1_proj.shape[0], x1_proj.shape[1]
     hidden = w_hh_ts[0].shape[0]
     itemsize = jnp.dtype(x1_proj.dtype).itemsize
     has_mask = masks is not None
-    if impl in ("pallas", "interpret") and not stack_fits(  # tracelint: disable=TL102
+    if impl in ("pallas", "interpret") and not stack_fits(  # mtt: disable=TL102 -- static shape/VMEM feasibility math on Python ints
         n_t, batch, hidden, ell, has_mask, itemsize
     ):
         if window_schedulable(batch, window_rows) and stack_fits(
@@ -1543,9 +1543,9 @@ def lstm_stack_recurrence(
                 *masks,
             )
         impl = "xla"
-    if impl in ("pallas", "interpret"):  # tracelint: disable=TL102
+    if impl in ("pallas", "interpret"):  # mtt: disable=TL102 -- impl is static host config, not a tracer
         return _lstm_stack_pallas(x1_proj, weights, masks, impl == "interpret")
-    if impl == "xla":  # tracelint: disable=TL102
+    if impl == "xla":  # mtt: disable=TL102 -- impl is static host config, not a tracer
         return lstm_stack_xla(x1_proj, weights, masks)
     raise ValueError(f"unknown lstm impl: {impl!r}")
 
@@ -1710,7 +1710,7 @@ def window_pack_width(b: int, window_rows: int | None, fits) -> int:
     for p in range(2, n_windows + 1):
         # Static host-side scheduling math (ints); flagged only because
         # cost profiling jits the dispatchers that call this.
-        if n_windows % p == 0 and fits(p * window_rows):  # tracelint: disable=TL102
+        if n_windows % p == 0 and fits(p * window_rows):  # mtt: disable=TL102 -- static host-side scheduling math on Python ints
             best = p
     return best
 

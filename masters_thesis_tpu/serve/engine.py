@@ -338,7 +338,7 @@ class PredictEngine:
             self._compiled.clear()
             self.cost_profiles.clear()
             for b in self.buckets:
-                self._compile_bucket(b)
+                self._compile_bucket(b)  # mtt: disable=CL503 -- CPU-degrade failover must swap params+programs atomically; callers accept the pause
 
     @classmethod
     def from_checkpoint(

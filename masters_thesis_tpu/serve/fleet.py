@@ -138,7 +138,7 @@ class Replica:
 
     def backlog_estimate_s(self) -> float:
         """Seconds until a batch assigned NOW would complete here."""
-        waiting = self.inbox.qsize() + (1 if self.busy_since else 0)
+        waiting = self.inbox.qsize() + (1 if self.busy_since else 0)  # mtt: disable=CL502 -- advisory estimate; a stale busy_since only skews replica choice
         return (waiting + 1) * self.service_model.batch_s
 
 
@@ -329,7 +329,7 @@ class FleetServer:
                 if r.span is not None:
                     tracer.end(
                         r.span, status="ok",
-                        completed=r.completed, busy_s=r.busy_s,
+                        completed=r.completed, busy_s=r.busy_s,  # mtt: disable=CL502 -- workers joined above; no concurrent writer remains
                     )
                     r.span = None
             if self._fleet_span is not None:
@@ -370,6 +370,17 @@ class FleetServer:
                 }
                 for r in self.replicas.values()
             }
+            # Fleet counters are mutated under this same lock; snapshot
+            # them here so the returned dict is internally consistent.
+            counters = {
+                "completed": self.completed,
+                "errors": self.errors,
+                "late_converted": self.late_converted,
+                "late_deliveries": self.late_deliveries,
+                "degradations": self.degradations,
+                "deaths": self.deaths,
+                "redispatched": self.redispatched,
+            }
         return {
             "replicas": per_replica,
             "n_live": sum(
@@ -380,17 +391,11 @@ class FleetServer:
             "compute_share": compute_share,
             "shed_by_reason": shed_by_reason,
             "requests": self.queue.submitted,
-            "completed": self.completed,
             "shed": self.queue.shed,
-            "errors": self.errors,
-            "late_converted": self.late_converted,
-            "late_deliveries": self.late_deliveries,
-            "degradations": self.degradations,
-            "deaths": self.deaths,
-            "redispatched": self.redispatched,
+            **counters,
             "p50_ms": None if p50 is None else p50 * 1e3,
             "p99_ms": None if p99 is None else p99 * 1e3,
-            "qps": self.completed / span if span > 0 else 0.0,
+            "qps": counters["completed"] / span if span > 0 else 0.0,
             "wall_s": span,
         }
 
@@ -717,7 +722,7 @@ class FleetServer:
         if tracer is not None and replica.span is not None:
             tracer.end(
                 replica.span, status="dead", cause=cause,
-                completed=replica.completed, busy_s=replica.busy_s,
+                completed=replica.completed, busy_s=replica.busy_s,  # mtt: disable=CL502 -- the dead replica's worker has exited; totals are final
             )
             replica.span = None
         self._redispatch(replica, orphans)

@@ -170,7 +170,12 @@ class MicroBatchQueue:
             return len(self._items)
 
     def _shed(self, pending: PendingRequest, reason: str) -> PendingRequest:
-        self.shed += 1
+        # Only the counter bump takes the lock: resolving the pending and
+        # the on_shed callback must run unlocked (the fleet's on_shed
+        # takes FleetServer._lock — holding _cond across it would create
+        # a lock-order inversion against the dispatch path).
+        with self._cond:
+            self.shed += 1
         now = time.monotonic()
         pending.resolve(
             ServeResponse(
@@ -190,8 +195,8 @@ class MicroBatchQueue:
         already resolved). Never blocks on capacity — backpressure is an
         explicit rejection, not a stalled caller."""
         pending = PendingRequest(request)
-        self.submitted += 1
         with self._cond:
+            self.submitted += 1
             depth = len(self._items)
             closed = self._closed
         if closed:

@@ -107,6 +107,10 @@ class PredictServer:
         self._rid_lock = threading.Lock()
         self._started_ts: float | None = None
         self._dispatch_seq = 0
+        # Stats counters are written from the dispatch thread and read by
+        # stats() from whatever thread asks; _stats_lock keeps the
+        # increments atomic and the snapshot consistent.
+        self._stats_lock = threading.Lock()
         self.completed = 0
         self.errors = 0
         self.late_converted = 0
@@ -131,6 +135,12 @@ class PredictServer:
     def _count(self, name: str, n: int = 1) -> None:
         if self.telemetry is not None:
             self.telemetry.counter(f"serve/{name}").inc(n)
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        """Locked increment of a stats counter, mirrored to telemetry."""
+        with self._stats_lock:
+            setattr(self, name, getattr(self, name) + n)
+        self._count(name, n)
 
     def _observe_latency(self, latency_s: float) -> None:
         if self.telemetry is not None:
@@ -314,8 +324,7 @@ class PredictServer:
         live: list[PendingRequest] = []
         for p in batch:
             if now + est > p.request.deadline_ts:
-                self.late_converted += 1
-                self._count("late_converted")
+                self._bump("late_converted")
                 self._resolve(
                     p, STATUS_REJECTED_LATE,
                     "deadline infeasible at dispatch (queue wait consumed "
@@ -325,8 +334,9 @@ class PredictServer:
                 live.append(p)
         if not live:
             return
-        seq = self._dispatch_seq
-        self._dispatch_seq += 1
+        with self._stats_lock:
+            seq = self._dispatch_seq
+            self._dispatch_seq += 1
         kind = faults.fire("serve.dispatch", seq=seq, n=len(live))
         tracer = self._tracer()
         t0_wall = time.time()
@@ -348,8 +358,7 @@ class PredictServer:
                 alpha = np.full_like(alpha, np.nan)
         except Exception as exc:  # noqa: BLE001 — any dispatch failure
             stamp("t_predict_end", time.perf_counter())
-            self.errors += len(live)
-            self._count("errors", len(live))
+            self._bump("errors", len(live))
             for p in live:
                 self._resolve(
                     p, STATUS_ERROR, f"{type(exc).__name__}: {exc}"
@@ -376,23 +385,20 @@ class PredictServer:
         now = time.monotonic()
         for i, p in enumerate(live):
             if not finite:
-                self.errors += 1
-                self._count("errors")
+                self._bump("errors")
                 self._resolve(
                     p, STATUS_ERROR,
                     "non-finite predictions; response withheld",
                 )
             elif now > p.request.deadline_ts:
-                self.late_converted += 1
-                self._count("late_converted")
+                self._bump("late_converted")
                 self._resolve(
                     p, STATUS_REJECTED_LATE,
                     "batch completed past the deadline; rejected rather "
                     "than delivered late",
                 )
             else:
-                self.completed += 1
-                self._count("completed")
+                self._bump("completed")
                 latency = now - p.request.submitted_ts
                 self._observe_latency(latency)
                 self._resolve(
@@ -402,8 +408,7 @@ class PredictServer:
                     # The delivery itself slid past the deadline — this
                     # must never happen (the check above runs against the
                     # same clock); count it so the bench can fail loudly.
-                    self.late_deliveries += 1
-                    self._count("late_deliveries")
+                    self._bump("late_deliveries")
 
     # ----------------------------------------------------------- degrade
 
@@ -422,8 +427,7 @@ class PredictServer:
                     cause=repr(cause),
                 )
                 return
-        self.degradations += 1
-        self._count("degradations")
+        self._bump("degradations")
         self.engine.degrade_to_cpu()
         self.service_model.seed(self.engine.warmup())
         self._event(

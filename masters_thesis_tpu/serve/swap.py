@@ -69,8 +69,8 @@ def canary_checks(
     # Host-side verdict math in f64 on purpose: the drift/abs thresholds
     # must not be blurred by the comparison's own f32 rounding. Never
     # traced — these arrays exist only on the host.
-    cur = [np.asarray(a, np.float64) for a in current]  # tracelint: disable=TL104
-    cand = [np.asarray(a, np.float64) for a in candidate]  # tracelint: disable=TL104
+    cur = [np.asarray(a, np.float64) for a in current]  # mtt: disable=TL104 -- host-only f64 canary comparison; param deltas must not blur in f32
+    cand = [np.asarray(a, np.float64) for a in candidate]  # mtt: disable=TL104 -- host-only f64 canary comparison; param deltas must not blur in f32
     checks: dict[str, float | bool] = {}
     finite = all(bool(np.isfinite(a).all()) for a in cand)
     checks["finite"] = finite

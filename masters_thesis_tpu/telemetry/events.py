@@ -68,24 +68,47 @@ class EventSink:
         if clashes:
             raise ValueError(f"payload keys clash with envelope: {clashes}")
         with self._lock:
-            event = {
-                "ts": time.time(),
-                "kind": kind,
-                "run": self.run_id,
-                "seq": self._seq,
-                "host": self._host,
-                "pid": self._pid,
-                "proc": self.proc,
-                "nproc": self.nproc,
-                "attempt": self.attempt,
-                **payload,
-            }
-            self._seq += 1
-            if self._file is None:
-                self._file = open(self.path, "a", encoding="utf-8")
-            self._file.write(json.dumps(event, default=_jsonable) + "\n")
-            self._file.flush()
-            return event
+            return self._emit_locked(kind, payload)  # mtt: disable=CL503 -- the serialized append IS the sink's contract; the lock exists to order writers
+
+    def try_emit(
+        self, kind: str, timeout: float = 0.25, **payload
+    ) -> dict | None:
+        """Bounded-acquire emit for signal-handler paths.
+
+        A handler that interrupted a frame already holding the sink lock
+        must give up after ``timeout`` rather than self-deadlock the
+        process (CPython runs handlers on the main thread). Returns None
+        when the event was dropped.
+        """
+        clashes = [k for k in payload if k in RESERVED_KEYS]
+        if clashes:
+            raise ValueError(f"payload keys clash with envelope: {clashes}")
+        if not self._lock.acquire(timeout=timeout):
+            return None
+        try:
+            return self._emit_locked(kind, payload)  # mtt: disable=CL503 -- bounded handler-path append; same serialized-writer contract as emit()
+        finally:
+            self._lock.release()
+
+    def _emit_locked(self, kind: str, payload: dict) -> dict:
+        event = {
+            "ts": time.time(),
+            "kind": kind,
+            "run": self.run_id,
+            "seq": self._seq,
+            "host": self._host,
+            "pid": self._pid,
+            "proc": self.proc,
+            "nproc": self.nproc,
+            "attempt": self.attempt,
+            **payload,
+        }
+        self._seq += 1  # mtt: disable=CL502 -- _emit_locked runs only with _lock held (emit/try_emit are the sole callers)
+        if self._file is None:
+            self._file = open(self.path, "a", encoding="utf-8")
+        self._file.write(json.dumps(event, default=_jsonable) + "\n")
+        self._file.flush()
+        return event
 
     def close(self) -> None:
         with self._lock:

@@ -26,6 +26,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -241,20 +242,26 @@ class CircuitBreaker:
         self.threshold = threshold
         self.consecutive = 0
         self.trips = 0
+        # Fleet replica workers each own a breaker, but the monitor
+        # thread reads trip counts and the supervisor shares one across
+        # attempt boundaries — the counters must be update-atomic.
+        self._lock = threading.Lock()
 
     def record_success(self) -> None:
-        self.consecutive = 0
+        with self._lock:
+            self.consecutive = 0
 
     def record_failure(self) -> bool:
         """Count a failure; True when this one trips the breaker (the
         consecutive count resets so the caller probes once per trip, not
         once per failure past the threshold)."""
-        self.consecutive += 1
-        if self.consecutive >= self.threshold:
-            self.consecutive = 0
-            self.trips += 1
-            return True
-        return False
+        with self._lock:
+            self.consecutive += 1
+            if self.consecutive >= self.threshold:
+                self.consecutive = 0
+                self.trips += 1
+                return True
+            return False
 
 
 def distributed_client_initialized() -> bool:

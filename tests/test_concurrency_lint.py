@@ -1,0 +1,698 @@
+"""Pass-3 static analysis: concurrency lint (CL5xx) + event contracts
+(EC6xx) + the unified suppression parser (SP001).
+
+Each rule gets a seeded fixture module proving it fires, a suppression
+proving it can be silenced (with a reason), and the final test pins the
+acceptance criterion: the repo itself lints clean under both passes.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from masters_thesis_tpu.analysis.concurrency import lint_concurrency
+from masters_thesis_tpu.analysis.contracts import build_schema, lint_contracts
+from masters_thesis_tpu.analysis.findings import (
+    parse_suppressions,
+    suppression_findings,
+)
+
+
+def _lint(tmp_path: Path, source: str, name: str = "fix.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint_concurrency([tmp_path])
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------------- CL501
+
+
+LOCK_ORDER_CYCLE = """
+    import threading
+
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_cl501_lock_order_inversion(tmp_path):
+    findings = _lint(tmp_path, LOCK_ORDER_CYCLE)
+    cl501 = [f for f in findings if f.rule == "CL501"]
+    assert len(cl501) == 2  # one per edge of the cycle
+    assert "opposite" in cl501[0].message or "reverse" in cl501[0].message
+
+
+def test_cl501_interprocedural_cycle(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _inner_b(self):
+                with self._b:
+                    pass
+
+            def ab(self):
+                with self._a:
+                    self._inner_b()
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """,
+    )
+    assert "CL501" in _rules(findings)
+
+
+def test_cl501_no_cycle_no_finding(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def also_ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """,
+    )
+    assert "CL501" not in _rules(findings)
+
+
+def test_cl501_rlock_reentry_ok(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Reent:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner_op()
+
+            def inner_op(self):
+                with self._lock:
+                    pass
+        """,
+    )
+    assert "CL501" not in _rules(findings)
+
+
+# ------------------------------------------------------------------- CL502
+
+
+UNGUARDED_COUNTER = """
+    import threading
+    import time
+
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while True:
+                self.count += 1
+                time.sleep(0.01)
+
+        def snapshot(self):
+            with self._lock:
+                return self.count
+"""
+
+
+def test_cl502_unguarded_rmw_counter(tmp_path):
+    findings = _lint(tmp_path, UNGUARDED_COUNTER)
+    cl502 = [f for f in findings if f.rule == "CL502"]
+    assert cl502, findings
+    assert "count" in cl502[0].message
+    assert "read-modify-write" in cl502[0].message
+
+
+def test_cl502_guarded_counter_clean(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+        import time
+
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+                    time.sleep(0.01)
+
+            def snapshot(self):
+                with self._lock:
+                    return self.count
+        """,
+    )
+    assert "CL502" not in _rules(findings)
+
+
+def test_cl502_single_threaded_class_not_flagged(tmp_path):
+    # No thread ever runs this class's methods: a bare += is fine.
+    findings = _lint(
+        tmp_path,
+        """
+        class Tally:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+        """,
+    )
+    assert "CL502" not in _rules(findings)
+
+
+def test_cl502_event_attr_exempt(tmp_path):
+    # threading.Event IS the synchronization; reading it unlocked is the
+    # point, not a race.
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.stop_event = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                while not self.stop_event.is_set():
+                    pass
+
+            def stop(self):
+                with self._lock:
+                    self.stop_event.set()
+        """,
+    )
+    assert "CL502" not in _rules(findings)
+
+
+# ------------------------------------------------------------------- CL503
+
+
+SLEEP_UNDER_LOCK = """
+    import threading
+    import time
+
+
+    class Slow:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def slow(self):
+            with self._lock:
+                time.sleep(1.0)
+"""
+
+
+def test_cl503_blocking_sleep_under_lock(tmp_path):
+    findings = _lint(tmp_path, SLEEP_UNDER_LOCK)
+    cl503 = [f for f in findings if f.rule == "CL503"]
+    assert cl503
+    assert "time.sleep" in cl503[0].message
+
+
+def test_cl503_interprocedural(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+        import time
+
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _io(self):
+                time.sleep(0.5)
+
+            def slow(self):
+                with self._lock:
+                    self._io()
+        """,
+    )
+    assert "CL503" in _rules(findings)
+
+
+def test_cl503_condition_wait_exempt(tmp_path):
+    # cond.wait() releases the condition it waits on — that's its job.
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Q:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def pop(self):
+                with self._cond:
+                    self._cond.wait(0.1)
+        """,
+    )
+    assert "CL503" not in _rules(findings)
+
+
+# ------------------------------------------------------------------- CL504
+
+
+def test_cl504_blocking_acquire_in_handler(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import signal
+        import threading
+
+
+        class Rec:
+            def __init__(self):
+                self._lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self.dump()
+
+            def dump(self):
+                with self._lock:
+                    return 1
+        """,
+    )
+    cl504 = [f for f in findings if f.rule == "CL504"]
+    assert cl504
+    assert "_lock" in cl504[0].message
+
+
+def test_cl504_bounded_acquire_ok(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import signal
+        import threading
+
+
+        class Rec:
+            def __init__(self):
+                self._lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self.dump()
+
+            def dump(self):
+                if not self._lock.acquire(timeout=0.25):
+                    return None
+                try:
+                    return 1
+                finally:
+                    self._lock.release()
+        """,
+    )
+    assert "CL504" not in _rules(findings)
+
+
+# ------------------------------------------------------------------- CL505
+
+
+def test_cl505_nondaemon_never_joined(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        def fire_and_forget(work):
+            t = threading.Thread(target=work)
+            t.start()
+        """,
+    )
+    cl505 = [f for f in findings if f.rule == "CL505"]
+    assert cl505
+    assert "never joined" in cl505[0].message
+
+
+def test_cl505_init_spawn_without_stop_path(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Daemonish:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+        """,
+    )
+    assert "CL505" in _rules(findings)
+
+
+def test_cl505_joined_thread_clean(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+
+
+        class Clean:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._t.join(timeout=1.0)
+        """,
+    )
+    assert "CL505" not in _rules(findings)
+
+
+# ------------------------------------------- suppressions (unified parser)
+
+
+def test_suppression_silences_with_reason(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+        import time
+
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)  # mtt: disable=CL503 -- test fixture
+        """,
+    )
+    assert "CL503" not in _rules(findings)
+    assert "SP001" not in _rules(findings)
+
+
+def test_bare_suppression_is_itself_a_finding(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import threading
+        import time
+
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)  # mtt: disable=CL503
+        """,
+    )
+    # The reason-less suppression still works, but the gate reports it.
+    assert "CL503" not in _rules(findings)
+    assert "SP001" in _rules(findings)
+
+
+def test_unified_parser_spellings():
+    src = (
+        "a = 1  # mtt: disable=CL502 -- why\n"
+        "b = 2  # tracelint: disable=TL101\n"
+        "c = 3  # noqa: TL103\n"
+        "d = 4  # noqa\n"
+    )
+    sups = {s.line: s for s in parse_suppressions(src)}
+    assert sups[1].spelling == "mtt"
+    assert sups[1].rules == frozenset({"CL502"})
+    assert sups[1].reason == "why"
+    assert sups[2].spelling == "tracelint" and sups[2].reason is None
+    assert sups[3].spelling == "noqa"
+    assert 4 not in sups  # bare noqa never swallows findings
+    sp = suppression_findings(src, "x.py")
+    assert [f.line for f in sp] == [2]  # only the reason-less tracelint
+
+
+# --------------------------------------------------------------- contracts
+
+
+def _contracts(tmp_path: Path, source: str, schema_path=None):
+    (tmp_path / "fix.py").write_text(textwrap.dedent(source))
+    return lint_contracts([tmp_path], schema_path=schema_path)
+
+
+READER_OF_MISSING_FIELD = """
+    def emit_all(sink):
+        sink.emit("epoch", epoch=1, wall_s=2.5)
+
+
+    def read_all(events):
+        by_kind = {}
+        for ev in events:
+            by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+        return [e.get("gpu_util") for e in by_kind.get("epoch", [])]
+"""
+
+
+def test_ec601_consumed_never_emitted(tmp_path):
+    findings = _contracts(tmp_path, READER_OF_MISSING_FIELD)
+    ec601 = [f for f in findings if f.rule == "EC601"]
+    assert ec601
+    assert "gpu_util" in ec601[0].message and "epoch" in ec601[0].message
+
+
+def test_ec601_satisfied_contract_clean(tmp_path):
+    findings = _contracts(
+        tmp_path,
+        """
+        def emit_all(sink):
+            sink.emit("epoch", epoch=1, wall_s=2.5)
+
+
+        def read_all(events):
+            by_kind = {}
+            for ev in events:
+                by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+            return [e.get("wall_s") for e in by_kind.get("epoch", [])]
+        """,
+    )
+    assert "EC601" not in _rules(findings)
+
+
+def test_ec601_dynamic_kind_exempt(tmp_path):
+    findings = _contracts(
+        tmp_path,
+        """
+        def emit_all(sink, payload):
+            sink.emit("metrics", **payload)
+
+
+        def read_all(by_kind):
+            return [e.get("whatever") for e in by_kind.get("metrics", [])]
+        """,
+    )
+    assert "EC601" not in _rules(findings)
+
+
+def test_ec601_kind_guard_binding(tmp_path):
+    # `if ev.get("kind") == ...` binds the var without a by_kind map.
+    findings = _contracts(
+        tmp_path,
+        """
+        def emit_all(sink):
+            sink.emit("epoch", epoch=1)
+
+
+        def read_all(events):
+            for ev in events:
+                if ev.get("kind") == "epoch":
+                    print(ev.get("missing_one"))
+        """,
+    )
+    assert any(
+        f.rule == "EC601" and "missing_one" in f.message for f in findings
+    )
+
+
+def test_ec602_emitter_type_conflict(tmp_path):
+    findings = _contracts(
+        tmp_path,
+        """
+        def emit_a(sink):
+            sink.emit("epoch", wall_s=2.5)
+
+
+        def emit_b(sink):
+            sink.emit("epoch", wall_s="fast")
+        """,
+    )
+    ec602 = [f for f in findings if f.rule == "EC602"]
+    assert ec602
+    assert "wall_s" in ec602[0].message
+
+
+def test_ec602_reader_numeric_cast_of_str(tmp_path):
+    findings = _contracts(
+        tmp_path,
+        """
+        def emit_all(sink):
+            sink.emit("epoch", label="third")
+
+
+        def read_all(by_kind):
+            return [float(e.get("label")) for e in by_kind.get("epoch", [])]
+        """,
+    )
+    assert any(
+        f.rule == "EC602" and "casts" in f.message for f in findings
+    )
+
+
+def test_ec603_drift_and_regeneration(tmp_path):
+    (tmp_path / "fix.py").write_text(
+        textwrap.dedent(
+            """
+            def emit_all(sink):
+                sink.emit("epoch", epoch=1, wall_s=2.5)
+            """
+        )
+    )
+    lock = tmp_path / "schema.json"
+    # Missing lockfile -> EC603.
+    findings = lint_contracts([tmp_path], schema_path=lock)
+    assert any(
+        f.rule == "EC603" and "missing" in f.message for f in findings
+    )
+    # Fresh lockfile -> clean.
+    lock.write_text(json.dumps(build_schema([tmp_path])))
+    assert not lint_contracts([tmp_path], schema_path=lock)
+    # Emitter gains a field -> drift.
+    (tmp_path / "fix.py").write_text(
+        textwrap.dedent(
+            """
+            def emit_all(sink):
+                sink.emit("epoch", epoch=1, wall_s=2.5, new_field=0)
+            """
+        )
+    )
+    findings = lint_contracts([tmp_path], schema_path=lock)
+    assert any(
+        f.rule == "EC603" and "new_field" in f.message for f in findings
+    )
+
+
+def test_ec_suppression(tmp_path):
+    findings = _contracts(
+        tmp_path,
+        """
+        def emit_all(sink):
+            sink.emit("epoch", epoch=1)
+
+
+        def read_all(by_kind):
+            return [e.get("gone") for e in by_kind.get("epoch", [])]  # mtt: disable=EC601 -- test fixture
+        """,
+    )
+    assert "EC601" not in _rules(findings)
+
+
+# ----------------------------------------------------- repo acceptance gate
+
+
+@pytest.mark.slow
+def test_repo_lints_clean_concurrency():
+    import masters_thesis_tpu
+
+    root = Path(masters_thesis_tpu.__file__).parent
+    findings = lint_concurrency([root], package_root=root)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.slow
+def test_repo_lints_clean_contracts():
+    import masters_thesis_tpu
+
+    root = Path(masters_thesis_tpu.__file__).parent
+    findings = lint_contracts(
+        [root],
+        package_root=root,
+        schema_path=root / "analysis" / "event_schema.json",
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_schema_lockfile_checked_in_and_fresh():
+    import masters_thesis_tpu
+
+    root = Path(masters_thesis_tpu.__file__).parent
+    lock = root / "analysis" / "event_schema.json"
+    assert lock.exists(), "run python -m masters_thesis_tpu.analysis --emit-schema"
+    current = build_schema([root], package_root=root)
+    assert json.loads(lock.read_text()) == current, (
+        "event_schema.json is stale — regenerate with --emit-schema"
+    )

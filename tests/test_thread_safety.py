@@ -1,0 +1,168 @@
+"""Regression tests for the races/lock hazards the Pass-3 concurrency
+lint surfaced (CL502/CL504), pinned against the concrete fixes:
+
+- ``FlightRecorder.dump`` used to take ``self._lock`` with a blocking
+  acquire on the signal path — a handler interrupting ``record()``/
+  ``note()`` mid-update would self-deadlock the process. Now bounded.
+- ``EventSink.try_emit`` is the bounded-acquire twin of ``emit`` for
+  handler paths; it must give up, not wait.
+- ``CircuitBreaker`` counters and ``MicroBatchQueue.submitted``/``shed``
+  were bare ``+=`` read-modify-writes reachable from multiple threads;
+  under contention they lose updates. Now locked.
+
+The deadlock tests are deterministic (they fail by timeout on the old
+code). The counter tests are contention tests: with a tiny switch
+interval and tens of thousands of increments, the old unlocked code
+loses updates with overwhelming probability.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.serve.queue import MicroBatchQueue, ServeRequest
+from masters_thesis_tpu.telemetry.events import EventSink
+from masters_thesis_tpu.telemetry.flightrec import FlightRecorder
+from masters_thesis_tpu.utils.backend_probe import CircuitBreaker
+
+
+@pytest.fixture
+def tight_switching():
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    yield
+    sys.setswitchinterval(old)
+
+
+def test_dump_survives_held_state_lock(tmp_path):
+    """A dump on the signal path must not block on the state lock.
+
+    Old code: ``dump`` did ``with self._lock:`` — with the lock held by
+    the interrupted frame, the worker below never finishes and the join
+    times out.
+    """
+    rec = FlightRecorder(
+        tmp_path,
+        run_id="t",
+        install_signal_handlers=False,
+        enable_faulthandler=False,
+    )
+    try:
+        rec.note(step="pretend-mid-update")
+        result = {}
+        rec._lock.acquire()
+        try:
+            t = threading.Thread(
+                target=lambda: result.update(p=rec.dump("held-lock-test")),
+                daemon=True,
+            )
+            t.start()
+            t.join(timeout=5.0)
+            assert not t.is_alive(), (
+                "dump() blocked forever on a held state lock — the "
+                "signal-path self-deadlock is back"
+            )
+        finally:
+            rec._lock.release()
+        # The dump still produced a crashdump (best-effort state copy).
+        assert result["p"] is not None
+        assert rec.crashdump_path.exists()
+    finally:
+        rec.close()
+
+
+def test_try_emit_gives_up_when_lock_held(tmp_path):
+    sink = EventSink(tmp_path / "events.jsonl", run_id="t")
+    sink.emit("epoch", epoch=0)  # open the file under normal conditions
+    sink._lock.acquire()
+    try:
+        t0 = time.monotonic()
+        out = sink.try_emit("crashdump", timeout=0.05, reason="x")
+        elapsed = time.monotonic() - t0
+        assert out is None
+        assert elapsed < 2.0
+    finally:
+        sink._lock.release()
+    # And with the lock free it emits normally.
+    ev = sink.try_emit("crashdump", reason="x")
+    assert ev is not None and ev["kind"] == "crashdump"
+    sink.close()
+
+
+def test_breaker_concurrent_failures_lose_nothing(tight_switching):
+    """4 threads x 25k failures with threshold=1: every failure trips.
+
+    Old code: ``self.trips += 1`` was an unlocked read-modify-write;
+    under a tiny switch interval the interleaved loads/stores drop
+    increments and the total comes up short.
+    """
+    breaker = CircuitBreaker(threshold=1)
+    n_threads, per_thread = 4, 25_000
+
+    def hammer():
+        for _ in range(per_thread):
+            breaker.record_failure()
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert breaker.trips == n_threads * per_thread
+
+
+def test_queue_submit_counter_exact_under_contention(tight_switching):
+    """Concurrent submits must be counted exactly (was a bare +=)."""
+    q = MicroBatchQueue(max_batch=8, max_wait_s=0.001, max_depth=1 << 30)
+    n_threads, per_thread = 4, 2_000
+    deadline = time.monotonic() + 3600.0
+    x = np.zeros((1, 2, 3))
+
+    def hammer(base):
+        for i in range(per_thread):
+            q.submit(ServeRequest(rid=base + i, x=x, deadline_ts=deadline))
+
+    threads = [
+        threading.Thread(target=hammer, args=(k * per_thread,))
+        for k in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert q.submitted == n_threads * per_thread
+    q.close()
+
+
+def test_shed_counter_consistent_with_responses(tight_switching):
+    """shed is bumped under the queue lock; every shed response is
+    matched by exactly one count even when submits race."""
+    q = MicroBatchQueue(max_batch=4, max_wait_s=0.001, max_depth=1)
+    deadline = time.monotonic() + 3600.0
+    x = np.zeros((1, 2, 3))
+    n_threads, per_thread = 4, 500
+    shed_responses = [0] * n_threads
+
+    def hammer(k):
+        for i in range(per_thread):
+            p = q.submit(
+                ServeRequest(rid=k * per_thread + i, x=x, deadline_ts=deadline)
+            )
+            if p.done and p.result(0).status == "shed":
+                shed_responses[k] += 1
+
+    threads = [
+        threading.Thread(target=hammer, args=(k,)) for k in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert q.shed == sum(shed_responses)
+    assert q.submitted == n_threads * per_thread
+    q.close()

@@ -11,7 +11,7 @@ fail=0
 #    agree on the rules).
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff =="
-    ruff check masters_thesis_tpu tests bench.py train.py || fail=1
+    ruff check masters_thesis_tpu tests bench.py train.py test.py || fail=1
 else
     echo "== ruff == (not installed; skipping)"
 fi
@@ -23,6 +23,34 @@ fi
 #    once with the same single batched all-reduce per dtype buffer).
 echo "== tracelint =="
 JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
+
+# 2b. Pass 3: concurrency lint (CL501-CL505 — lock-order inversions,
+#     unguarded shared state, blocking calls under locks / in signal
+#     handlers, thread lifecycle) + event-schema contract check
+#     (EC601-EC603) against the checked-in lockfile.
+echo "== concurrency + contract lint =="
+python -m masters_thesis_tpu.analysis --concurrency --contracts || fail=1
+
+# 2c. The event-schema lockfile must match what the code actually emits;
+#     regenerate with `python -m masters_thesis_tpu.analysis --emit-schema`
+#     after changing emitters.
+echo "== event schema freshness =="
+python - <<'PY' || fail=1
+import json, sys
+from pathlib import Path
+from masters_thesis_tpu.analysis.contracts import build_schema
+
+root = Path("masters_thesis_tpu")
+schema = build_schema([root], package_root=root)
+lock = root / "analysis" / "event_schema.json"
+if json.loads(lock.read_text()) != schema:
+    print(
+        "event_schema.json is stale — run "
+        "`python -m masters_thesis_tpu.analysis --emit-schema`",
+        file=sys.stderr,
+    )
+    raise SystemExit(1)
+PY
 
 # 3. telemetry: hermetic registry -> events -> report smoke, plus the
 #    simulated-fleet flight-recorder -> aggregate -> postmortem smoke
