@@ -1319,6 +1319,27 @@ def main() -> None:
             )
             sys.exit(2)
         print("preflight: serve audit ok", file=sys.stderr)
+        # Fleet supervisor smoke (jax-free, runs as a child like serve):
+        # a 2-rank fleet loses a rank to SIGKILL and must relaunch from
+        # committed progress bit-identically, and a deterministic rank
+        # loss must elastically resize — a long bench run leans on
+        # exactly this recovery path when a host dies mid-sweep.
+        fleet_pf = subprocess.run(
+            [
+                sys.executable, "-m", "masters_thesis_tpu.resilience",
+                "fleet", "--selfcheck",
+            ],
+            cwd=Path(__file__).resolve().parent,
+            timeout=600,
+        )
+        if fleet_pf.returncode != 0:
+            print(
+                "preflight: fleet selfcheck failed "
+                f"(exit {fleet_pf.returncode})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        print("preflight: fleet recovery ok", file=sys.stderr)
     degraded, probe_attempts = _ensure_responsive_backend()
     from masters_thesis_tpu.data.pipeline import (
         FinancialWindowDataModule,

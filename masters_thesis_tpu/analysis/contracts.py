@@ -59,6 +59,7 @@ EMIT_METHOD_NAMES = {"emit", "event", "_event", "try_emit"}
 # RESERVED_KEYS) — always considered emitted.
 ENVELOPE_KEYS = {
     "ts", "kind", "run", "seq", "host", "pid", "proc", "nproc", "attempt",
+    "generation",
 }
 
 # Fields Tracer._emit writes for every span event; an ``emit_span`` call

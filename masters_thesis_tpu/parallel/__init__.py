@@ -12,22 +12,30 @@ insert collectives.
 
 from masters_thesis_tpu.parallel.mesh import (
     DATA_AXIS,
+    balanced_shard_sizes,
     batch_sharding,
     distributed_initialize,
     distributed_run_context,
+    fleet_barrier,
     global_put,
+    join_fleet,
     make_data_mesh,
     replicated_sharding,
+    shard_bounds,
     shard_map,
 )
 
 __all__ = [
     "DATA_AXIS",
+    "balanced_shard_sizes",
     "batch_sharding",
     "distributed_initialize",
     "distributed_run_context",
+    "fleet_barrier",
     "global_put",
+    "join_fleet",
     "make_data_mesh",
     "replicated_sharding",
+    "shard_bounds",
     "shard_map",
 ]

@@ -26,7 +26,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from masters_thesis_tpu.resilience import faults
+
 DATA_AXIS = "data"
+
+#: Coordinator address exported by the fleet supervisor for each
+#: generation (a fresh address per relaunch: the old coordinator died
+#: with the old fleet). Read by :func:`join_fleet`.
+COORDINATOR_ENV = "MTT_COORDINATOR"
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -48,6 +55,30 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_rep=check_vma,
     )
+
+
+def _enable_cpu_collectives() -> None:
+    """Switch the CPU client to gloo collectives before distributed init.
+
+    The XLA CPU backend refuses cross-process computations under its
+    default collectives ("Multiprocess computations aren't implemented
+    on the CPU backend"); the gloo implementation shipped with jaxlib
+    handles them. Must run before ``jax.distributed.initialize`` / the
+    first backend touch — hence called from the init guards, never after.
+    A TPU/GPU platform ignores the flag, and older jax without the
+    option just keeps its default.
+    """
+    platforms = (
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS", "")
+        or ""
+    )
+    if "cpu" not in platforms.split(","):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 def distributed_initialize(
@@ -78,6 +109,7 @@ def distributed_initialize(
 
     if distributed_client_initialized():
         return
+    _enable_cpu_collectives()
     try:
         if coordinator_address is None and num_processes is None:
             jax.distributed.initialize()
@@ -105,6 +137,88 @@ def distributed_initialize(
         os.environ.setdefault("JAX_PROCESS_COUNT", str(jax.process_count()))
     except Exception:
         pass
+
+
+def join_fleet(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """World-size-parameterized (re-)initialization for a supervised
+    fleet rank: read the identity the fleet supervisor exported
+    (``MTT_COORDINATOR`` + ``JAX_PROCESS_INDEX``/``JAX_PROCESS_COUNT``,
+    which change across generations when the fleet is elastically
+    resized), initialize ``jax.distributed`` against this generation's
+    coordinator, and return ``(process_id, num_processes)``.
+
+    Init is *required* when a coordinator was exported: a rank that
+    silently fell back to single-process training would train on 1/Nth
+    of the data and publish a checkpoint the rest of the fleet never
+    agreed on. Single-process launches (no coordinator in the env) are a
+    no-op, so workers can use this unconditionally.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        COORDINATOR_ENV
+    )
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_PROCESS_COUNT", "1") or 1)
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_INDEX", "0") or 0)
+    if coordinator_address and num_processes > 1:
+        distributed_initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            required=True,
+        )
+    return process_id, num_processes
+
+
+def shard_bounds(n: int, world: int, rank: int) -> tuple[int, int]:
+    """Balanced contiguous ``[lo, hi)`` bounds of ``rank``'s shard of
+    ``n`` items across ``world`` processes.
+
+    The remainder spreads over the FIRST ``n % world`` ranks, so shard
+    sizes differ by at most one and the assignment is a pure function of
+    ``(n, world, rank)`` — after an elastic resize every survivor
+    recomputes its shard from the new world size and the union still
+    covers all ``n`` items exactly once. This is the re-balancing rule
+    the fleet supervisor relies on when it relaunches at N-1.
+    """
+    if world <= 0:
+        raise ValueError(f"world must be positive, got {world}")
+    if not 0 <= rank < world:
+        raise ValueError(f"rank {rank} outside [0, {world})")
+    base, extra = divmod(n, world)
+    lo = rank * base + min(rank, extra)
+    return lo, lo + base + (1 if rank < extra else 0)
+
+
+def balanced_shard_sizes(n: int, world: int) -> list[int]:
+    """Per-rank shard sizes under :func:`shard_bounds` (diagnostics and
+    batch-divisibility checks)."""
+    return [hi - lo for lo, hi in
+            (shard_bounds(n, world, r) for r in range(world))]
+
+
+def fleet_barrier(name: str) -> None:
+    """Named cross-process sync point; no-op in single-process runs.
+
+    Wraps ``multihost_utils.sync_global_devices`` behind the
+    ``dist.barrier`` fault point so chaos plans can wedge one rank
+    inside the barrier — the exact survivor pathology a dead host
+    induces in a real collective, and what the fleet supervisor's
+    hang watchdog must convert into an all-rank relaunch.
+    """
+    faults.fire("dist.barrier", name=name)
+    try:
+        if jax.process_count() <= 1:
+            return
+    except RuntimeError:
+        return  # no backend yet: nothing to synchronize
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
 
 
 def distributed_run_context() -> dict:

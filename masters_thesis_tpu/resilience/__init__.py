@@ -12,6 +12,11 @@ Two halves, one contract:
   with exponential backoff under a budget, evidence-based failure
   classification (transient vs deterministic), divergence rollback with
   optional LR halving, and graceful CPU degradation on a wedged backend.
+- :mod:`masters_thesis_tpu.resilience.fleetsup` — the N-process analogue
+  (``python -m masters_thesis_tpu.resilience fleet``): any rank dead or
+  hung restarts the WHOLE fleet from the last manifest-verified
+  checkpoint; deterministic host loss elastically degrades to N-1 with
+  shards re-balanced, one trace id threading every generation.
 
 This package (like the telemetry CLIs) is jax-free by contract: the
 supervisor must work exactly when the accelerator runtime is wedged.
@@ -22,9 +27,13 @@ from masters_thesis_tpu.resilience.faults import FaultInjected, FaultPlan, Fault
 
 __all__ = [
     "faults",
+    "DecorrelatedBackoff",
     "FaultInjected",
     "FaultPlan",
     "FaultSpec",
+    "FleetConfig",
+    "FleetResult",
+    "FleetSupervisor",
     "ReplicaRestartPolicy",
     "ReplicaVerdict",
     "RunSupervisor",
@@ -45,4 +54,12 @@ def __getattr__(name: str):
         from masters_thesis_tpu.resilience import supervisor
 
         return getattr(supervisor, name)
+    if name in ("FleetConfig", "FleetResult", "FleetSupervisor"):
+        from masters_thesis_tpu.resilience import fleetsup
+
+        return getattr(fleetsup, name)
+    if name == "DecorrelatedBackoff":
+        from masters_thesis_tpu.resilience.backoff import DecorrelatedBackoff
+
+        return DecorrelatedBackoff
     raise AttributeError(name)

@@ -16,7 +16,14 @@ Subcommands:
                   halt, NaN divergence -> rollback with LR scaling. Exits
                   non-zero on any failed scenario. Mirrors
                   ``telemetry postmortem --selfcheck``.
+- ``fleet``     — supervise an N-process fleet: any rank dead or hung
+                  restarts the WHOLE fleet from the last verified
+                  checkpoint; deterministic host loss degrades to N-1
+                  (``--selfcheck`` runs a hermetic 2-rank fleet with an
+                  injected rank kill and a deterministic-loss resize).
 - ``worker``    — internal: the simulated trainee the selfcheck supervises.
+- ``fleet-worker`` — internal: the simulated fleet rank (shared atomic
+                  progress commit, crash/hang injection per rank).
 """
 
 from __future__ import annotations
@@ -195,6 +202,283 @@ def _cmd_worker(args) -> int:
     return 0
 
 
+# -------------------------------------------------------------------- fleet
+
+
+def _fleet_cfg_from_args(args):
+    from masters_thesis_tpu.resilience.fleetsup import FleetConfig
+
+    return FleetConfig(
+        nprocs=args.nprocs,
+        min_nprocs=args.min_nprocs,
+        max_relaunches_per_size=args.max_relaunches_per_size,
+        max_generations=args.max_generations,
+        backoff_s=args.backoff_s,
+        backoff_factor=args.backoff_factor,
+        max_backoff_s=args.max_backoff_s,
+        hang_timeout_s=args.hang_timeout_s,
+        term_grace_s=args.term_grace_s,
+        poll_interval_s=args.poll_interval_s,
+        boot_timeout_s=args.boot_timeout_s,
+    )
+
+
+def _cmd_fleet(args) -> int:
+    from masters_thesis_tpu.resilience.fleetsup import FleetSupervisor
+
+    if args.selfcheck:
+        return _fleet_selfcheck(args)
+    if not args.cmd:
+        print("fleet: no command given (use `-- cmd ...`)", file=sys.stderr)
+        return 2
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    sup = FleetSupervisor(
+        cmd,
+        run_dir=args.run_dir,
+        cfg=_fleet_cfg_from_args(args),
+        ckpt_dir=args.ckpt_dir,
+    )
+    result = sup.run()
+    print(
+        f"[fleetsup] verdict={result.verdict}"
+        f" generations={result.n_generations}"
+        f" final_nprocs={result.final_nprocs}"
+        + (" resized" if result.resized else "")
+        + f" trace={result.trace_id}",
+        file=sys.stderr,
+    )
+    if result.ok:
+        return 0
+    return 2 if result.verdict == "deterministic" else 1
+
+
+# ------------------------------------------------------------- fleet-worker
+
+
+def _fleet_shard(n: int, world: int, rank: int) -> tuple[int, int]:
+    # Inline mirror of parallel.mesh.shard_bounds — this worker must stay
+    # jax-free and mesh.py imports jax at module level. Kept in lockstep
+    # by tests/test_fleetsup.py.
+    base, extra = divmod(n, world)
+    lo = rank * base + min(rank, extra)
+    return lo, lo + base + (1 if rank < extra else 0)
+
+
+def _cmd_fleet_worker(args) -> int:
+    """Simulated fleet rank for the fleet selfcheck and tests.
+
+    Shards ``--items`` across the generation's world size (env identity
+    exported by the fleet supervisor), heartbeats through the flight
+    recorder, and — on rank 0 only, mirroring the real checkpoint's
+    rank-0 publish contract — commits progress ATOMICALLY after each
+    epoch: one file holding the resume epoch, a rolling "params" value,
+    and the full work history. A kill at any instant therefore leaves
+    every committed epoch in the history exactly once, which is what the
+    bit-identical-resume assertion checks.
+    """
+    import os
+    import signal as _signal
+
+    from masters_thesis_tpu.resilience import faults
+    from masters_thesis_tpu.telemetry import TelemetryRun
+    from masters_thesis_tpu.utils import atomic_write_text
+
+    rank = int(os.environ.get("JAX_PROCESS_INDEX", "0") or 0)
+    world = int(os.environ.get("JAX_PROCESS_COUNT", "1") or 1)
+    gen = int(os.environ.get("MTT_GENERATION", "0") or 0)
+    attempt = faults.current_attempt()
+    state = Path(args.state)
+    state.mkdir(parents=True, exist_ok=True)
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    lo, hi = _fleet_shard(args.items, world, rank)
+    with open(state / "shards.log", "a") as f:
+        # Single short write under O_APPEND: atomic across ranks.
+        f.write(f"{gen} {world} {rank} {lo} {hi}\n")
+
+    progress = state / "progress.json"
+    start, value, history = 0, 0, []
+    if progress.exists():
+        try:
+            obj = json.loads(progress.read_text())
+            start = obj["epoch"] + 1
+            value = obj["value"]
+            history = obj["history"]
+        except (ValueError, KeyError):
+            start, value, history = 0, 0, []
+
+    tel = TelemetryRun(out / "telemetry", run_id=f"fleet-worker-p{rank}")
+    rec = tel.attach_flight_recorder(heartbeat_interval_s=0.1)
+    tel.event(
+        "run_started",
+        rank=rank,
+        world=world,
+        gen=gen,
+        shard=[lo, hi],
+        resumed_from=str(progress) if start else None,
+    )
+    for epoch in range(start, args.epochs):
+        rec.beat(phase="epoch", epoch=epoch)
+        faults.fire("worker.epoch", epoch=epoch, rank=rank)
+        crash_here = (
+            args.crash_rank is not None
+            and rank == args.crash_rank
+            and epoch >= args.at
+            and (args.crash_mode == "always" or gen == 0)
+        )
+        if crash_here:
+            tel.event("epoch_crash", rank=rank, gen=gen, epoch=epoch)
+            if args.crash_kind == "kill":
+                os.kill(os.getpid(), _signal.SIGKILL)
+            print(
+                "RuntimeError: injected deterministic rank failure",
+                file=sys.stderr,
+            )
+            tel.close()
+            return 3
+        if (
+            args.hang_rank is not None
+            and rank == args.hang_rank
+            and epoch == args.at
+            and gen == 0
+        ):
+            while True:  # a wedged collective, as seen from the host
+                time.sleep(3600)
+        if args.sleep_s:
+            time.sleep(args.sleep_s)
+        if rank == 0:
+            # The single commit point: value + history move together or
+            # not at all (atomic replace), so a SIGKILL mid-epoch can
+            # never record the epoch half-done.
+            value = (value * 1000003 + epoch) % (2**61 - 1)
+            history.append([attempt, gen, world, epoch])
+            atomic_write_text(
+                progress,
+                json.dumps(
+                    {"epoch": epoch, "value": value, "history": history}
+                ),
+            )
+    tel.event("run_finished", rank=rank, world=world, gen=gen,
+              epochs=args.epochs)
+    tel.close()
+    return 0
+
+
+def _fleet_expected_value(epochs: int) -> int:
+    value = 0
+    for epoch in range(epochs):
+        value = (value * 1000003 + epoch) % (2**61 - 1)
+    return value
+
+
+def _fleet_selfcheck(args) -> int:
+    """Hermetic fleet smoke: (1) a 2-rank fleet loses one rank to an
+    injected SIGKILL mid-epoch -> whole-fleet relaunch resumes from the
+    committed progress and the final value is bit-identical to a
+    fault-free run, every epoch done exactly once; (2) a deterministic
+    rank failure (same fingerprint twice) -> elastic resize to 1 rank,
+    which completes."""
+    from masters_thesis_tpu.resilience.fleetsup import (
+        FleetConfig,
+        FleetSupervisor,
+    )
+
+    tmp = Path(tempfile.mkdtemp(prefix="fleet-selfcheck-"))
+    failures: list[str] = []
+    epochs = 5
+    expected = _fleet_expected_value(epochs)
+
+    def fleet_cmd(state: Path, *extra: str) -> list[str]:
+        return [
+            sys.executable,
+            "-m",
+            "masters_thesis_tpu.resilience",
+            "fleet-worker",
+            "--state",
+            str(state),
+            "--out",
+            "{out}",
+            "--epochs",
+            str(epochs),
+            "--items",
+            "64",
+            "--sleep-s",
+            "0.05",
+            *extra,
+        ]
+
+    fast = FleetConfig(
+        nprocs=2,
+        min_nprocs=1,
+        max_relaunches_per_size=2,
+        backoff_s=0.05,
+        backoff_factor=1.0,
+        term_grace_s=2.0,
+        poll_interval_s=0.05,
+    )
+
+    def check_progress(state: Path, label: str) -> None:
+        obj = json.loads((state / "progress.json").read_text())
+        done = [entry[3] for entry in obj["history"]]
+        if done != list(range(epochs)):
+            failures.append(
+                f"{label}: history epochs {done} != {list(range(epochs))} "
+                "(resume redid or skipped committed work)"
+            )
+        elif obj["value"] != expected:
+            failures.append(
+                f"{label}: final value {obj['value']} != fault-free "
+                f"{expected} (resume is not bit-identical)"
+            )
+
+    # 1. rank 1 SIGKILLed mid-epoch -> all-rank relaunch, verified resume
+    state = tmp / "kill-state"
+    res = FleetSupervisor(
+        fleet_cmd(state, "--crash-rank", "1", "--at", "1",
+                  "--crash-kind", "kill"),
+        run_dir=tmp / "kill-run",
+        cfg=fast,
+    ).run()
+    if not res.ok or res.n_generations != 2 or res.resized:
+        failures.append(
+            f"kill-relaunch: verdict={res.verdict} "
+            f"generations={res.n_generations} resized={res.resized} "
+            "(want completed in exactly 2 generations, no resize)"
+        )
+    else:
+        check_progress(state, "kill-relaunch")
+
+    # 2. deterministic rank loss -> same fingerprint twice -> resize to 1
+    state = tmp / "det-state"
+    res = FleetSupervisor(
+        fleet_cmd(state, "--crash-rank", "1", "--at", "1",
+                  "--crash-mode", "always"),
+        run_dir=tmp / "det-run",
+        cfg=fast,
+    ).run()
+    if not res.ok or not res.resized or res.final_nprocs != 1:
+        failures.append(
+            f"deterministic-resize: verdict={res.verdict} "
+            f"generations={res.n_generations} resized={res.resized} "
+            f"final_nprocs={res.final_nprocs} "
+            "(want elastic degradation to 1 rank, then completion)"
+        )
+    else:
+        check_progress(state, "deterministic-resize")
+
+    if getattr(args, "keep", False):
+        print(f"fleet selfcheck artifacts kept at {tmp}", file=sys.stderr)
+    else:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"fleet selfcheck FAILED: {f}", file=sys.stderr)
+        return 1
+    print("fleet selfcheck: 2 scenarios OK")
+    return 0
+
+
 # ---------------------------------------------------------------- selfcheck
 
 
@@ -334,6 +618,55 @@ def main(argv=None) -> int:
     p_self.add_argument("--keep", action="store_true",
                         help="keep the scratch dir for inspection")
 
+    p_fleet = sub.add_parser("fleet", help="supervise an N-process fleet")
+    p_fleet.add_argument("--run-dir", type=Path, default=None)
+    p_fleet.add_argument("--ckpt-dir", type=Path, default=None)
+    p_fleet.add_argument("--nprocs", type=int, default=2)
+    p_fleet.add_argument("--min-nprocs", type=int, default=1,
+                         help="elastic-resize floor; below this a "
+                         "deterministic failure halts the fleet")
+    p_fleet.add_argument("--max-relaunches-per-size", type=int, default=2)
+    p_fleet.add_argument("--max-generations", type=int, default=8)
+    p_fleet.add_argument("--backoff-s", type=float, default=1.0)
+    p_fleet.add_argument("--backoff-factor", type=float, default=2.0)
+    p_fleet.add_argument("--max-backoff-s", type=float, default=60.0)
+    p_fleet.add_argument("--hang-timeout-s", type=float, default=None,
+                         help="heartbeat staleness after which a rank "
+                         "counts as hung and the fleet restarts")
+    p_fleet.add_argument("--term-grace-s", type=float, default=5.0)
+    p_fleet.add_argument("--poll-interval-s", type=float, default=0.2)
+    p_fleet.add_argument("--boot-timeout-s", type=float, default=None)
+    p_fleet.add_argument("--selfcheck", action="store_true",
+                         help="run the hermetic 2-rank fleet smoke "
+                         "instead of supervising a command")
+    p_fleet.add_argument("--keep", action="store_true",
+                         help="(selfcheck) keep the scratch dir")
+    p_fleet.add_argument("cmd", nargs=argparse.REMAINDER,
+                         help="per-rank command template; {rank} {world} "
+                         "{coordinator} {gen} {out} {root} substituted")
+
+    p_fwrk = sub.add_parser("fleet-worker")  # internal, used by selfcheck
+    p_fwrk.add_argument("--state", type=Path, required=True,
+                        help="shared dir: atomic progress + shard log")
+    p_fwrk.add_argument("--out", type=Path, required=True)
+    p_fwrk.add_argument("--epochs", type=int, default=4)
+    p_fwrk.add_argument("--items", type=int, default=64,
+                        help="total items sharded across the fleet")
+    p_fwrk.add_argument("--sleep-s", type=float, default=0.0)
+    p_fwrk.add_argument("--crash-rank", type=int, default=None)
+    p_fwrk.add_argument("--hang-rank", type=int, default=None)
+    p_fwrk.add_argument("--at", type=int, default=1,
+                        help="epoch at which the injected failure fires")
+    p_fwrk.add_argument("--crash-mode", choices=("once", "always"),
+                        default="once",
+                        help="once: generation 0 only (transient); "
+                        "always: every generation the rank exists in "
+                        "(deterministic host loss)")
+    p_fwrk.add_argument("--crash-kind", choices=("exit", "kill"),
+                        default="exit",
+                        help="exit: rc=3 with a stderr line; kill: "
+                        "SIGKILL self (no evidence beyond the signal)")
+
     p_wrk = sub.add_parser("worker")  # internal, used by selfcheck
     p_wrk.add_argument("--out", type=Path, required=True)
     p_wrk.add_argument("--mode", choices=("ok", "crash", "nan", "hang"),
@@ -350,8 +683,12 @@ def main(argv=None) -> int:
         return _cmd_classify(args)
     if args.command == "selfcheck":
         return _selfcheck(args)
+    if args.command == "fleet":
+        return _cmd_fleet(args)
     if args.command == "worker":
         return _cmd_worker(args)
+    if args.command == "fleet-worker":
+        return _cmd_fleet_worker(args)
     return 2
 
 

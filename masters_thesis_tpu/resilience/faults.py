@@ -69,7 +69,17 @@ POINTS = frozenset(
         # trainer (kind: nan; match on {"replica": r} to poison one replica)
         "data.epoch",  # host data plane, once per epoch stream
         "checkpoint.pre_publish",  # staged pair complete, not yet live
+        "checkpoint.mid_publish",  # rotation done, staged tree not yet
+        # live (kind: kill tears the publish at its most exposed point —
+        # proving the .prev rotation still verifies and recovery finishes
+        # the swap)
         "checkpoint.post_publish",  # after publish (kind: corrupt)
+        "dist.barrier",  # cross-process sync points (mesh.fleet_barrier):
+        # hang wedges one rank inside the barrier, exactly the survivor
+        # pathology a dead host induces in a real collective
+        "fleet.rank_heartbeat",  # fleet supervisor's per-rank staleness
+        # check (kind: wedge -> the supervisor treats the rank's heartbeat
+        # as stale without needing a real hang; match on {"rank": r})
         "probe.attempt",  # backend probe attempt (kind: wedge)
         "worker.epoch",  # jax-free selfcheck worker epochs
         "serve.admit",  # request admission (kind: wedge -> forced shed)

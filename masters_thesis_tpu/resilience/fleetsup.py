@@ -1,0 +1,634 @@
+"""Fleet supervisor: all-rank relaunch + elastic resize for N-process runs.
+
+The one hard failure mode a data-parallel fleet has that the single-run
+:class:`~masters_thesis_tpu.resilience.supervisor.RunSupervisor` cannot
+see: a host dies and every SURVIVOR wedges forever inside the next
+collective — alive, heartbeating its import/setup phases, making no
+progress. The fleet invariant this module enforces:
+
+    any rank dead, or hung past ``hang_timeout_s``
+        => terminate ALL ranks (SIGTERM, grace, SIGKILL),
+           classify the failure with the shared evidence rules,
+           relaunch the WHOLE fleet from the last manifest-verified
+           checkpoint (resume makes the retry bit-identical to a
+           fault-free run — the trainer's own restore contract).
+
+Each whole-fleet (re)launch is a **generation**: generation 0 is the
+first launch; every relaunch increments it, exports ``MTT_GENERATION``
+(the telemetry envelope's generation tag) and ``MTT_ATTEMPT`` =
+generation + 1 (so fault plans stay attempt-scoped and the aggregate
+CLI's attempt linking works unchanged), and gets a FRESH coordinator
+address (the old coordinator died with the old rank 0).
+
+Elastic degradation: when the evidence says a host is deterministically
+gone — the same crash fingerprint on two consecutive fleet failures — or
+the full-size relaunch budget is spent, the fleet relaunches at world
+size N-1 instead of halting, emitting ``fleet_resized``. Data-parallel
+shards re-balance purely from the new world size
+(:func:`masters_thesis_tpu.parallel.mesh.shard_bounds` is a pure
+function of ``(n, world, rank)``), and ONE trace id threads through
+every generation so ``aggregate``/``postmortem`` stitch the attempt
+chain into a single incident.
+
+Jax-free by contract, single-threaded by design: the monitor is one
+poll loop (child returncodes + per-rank heartbeat staleness through the
+flight-recorder channel), so there is no lock ordering, no signal
+handler, and nothing for the concurrency lint to find. Relaunch backoff
+uses the shared decorrelated jitter — N ranks re-binding to a fresh
+coordinator must not thundering-herd it.
+
+CLI: ``python -m masters_thesis_tpu.resilience fleet`` (see __main__).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Sequence
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.backoff import DecorrelatedBackoff
+from masters_thesis_tpu.resilience.faults import ATTEMPT_ENV
+from masters_thesis_tpu.resilience.supervisor import (
+    Classification,
+    _read_json,
+    _tail,
+    classify_exit,
+)
+from masters_thesis_tpu.telemetry.events import GENERATION_ENV
+from masters_thesis_tpu.telemetry.trace import (
+    PARENT_SPAN_ENV,
+    TRACE_ENV,
+    new_trace_id,
+)
+
+#: Coordinator address env exported per generation (mirrors
+#: parallel.mesh.COORDINATOR_ENV — that module imports jax, this one
+#: must not).
+COORDINATOR_ENV = "MTT_COORDINATOR"
+
+#: Template placeholders a fleet command may use; substituted per rank
+#: and per generation.
+TEMPLATE_KEYS = ("rank", "world", "coordinator", "gen", "out", "root")
+
+
+@dataclass
+class FleetConfig:
+    nprocs: int = 2
+    #: Floor for elastic resize; at this size a deterministic failure
+    #: halts instead (min_nprocs == nprocs disables resizing entirely).
+    min_nprocs: int = 1
+    #: Full-size relaunch budget: transient fleet failures retried at
+    #: the CURRENT world size before degrading to N-1.
+    max_relaunches_per_size: int = 2
+    #: Hard cap on generations across all sizes (runaway backstop).
+    max_generations: int = 8
+    backoff_s: float = 1.0
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 60.0
+    #: Heartbeat staleness -> the rank is hung and the fleet restarts.
+    #: Must comfortably exceed worker boot (jax import + compile).
+    hang_timeout_s: float | None = None
+    term_grace_s: float = 5.0
+    poll_interval_s: float = 0.2
+    #: Per-rank launch stagger (uniform jitter) so N processes don't
+    #: slam the coordinator in the same instant.
+    launch_stagger_s: float = 0.0
+    #: With a {coordinator} template: rank 0 must open the coordinator
+    #: service within this budget or the generation is a boot failure.
+    boot_timeout_s: float | None = None
+
+
+@dataclass
+class _Rank:
+    rank: int
+    proc: subprocess.Popen
+    dir: Path
+    out_path: Path
+    err_path: Path
+    files: tuple
+
+
+@dataclass
+class GenerationOutcome:
+    gen: int
+    nprocs: int
+    ok: bool
+    wall_s: float
+    pids: list[int] = field(default_factory=list)
+    failed_rank: int | None = None
+    rc: int | None = None
+    hang_killed: bool = False
+    classification: Classification | None = None
+
+
+@dataclass
+class FleetResult:
+    ok: bool
+    verdict: str  # completed | deterministic | retries_exhausted |
+    #               budget_exhausted
+    generations: list[GenerationOutcome] = field(default_factory=list)
+    final_nprocs: int = 0
+    resized: bool = False
+    trace_id: str | None = None
+
+    @property
+    def n_generations(self) -> int:
+        return len(self.generations)
+
+
+class FleetSupervisor:
+    """Launch and heal an N-process fleet per the module contract.
+
+    ``cmd_template`` is the per-rank command with ``{rank}``/``{world}``/
+    ``{coordinator}``/``{gen}``/``{out}``/``{root}`` placeholders; each
+    rank's telemetry lands in ``<run_dir>/g<gen>/p<rank>/`` (the ``{out}``
+    substitution) so every generation's forensic evidence survives the
+    relaunch that supersedes it. ``ckpt_dir`` (optional) is the shared
+    checkpoint root the fleet resumes from; the supervisor reports the
+    last manifest-verified restore point per relaunch, jax-free.
+    """
+
+    def __init__(
+        self,
+        cmd_template: Sequence[str],
+        run_dir: Path | str,
+        cfg: FleetConfig | None = None,
+        env: dict | None = None,
+        ckpt_dir: Path | str | None = None,
+        coordinator_host: str = "127.0.0.1",
+    ) -> None:
+        self.cmd_template = [str(a) for a in cmd_template]
+        self.run_dir = Path(run_dir)
+        self.cfg = cfg or FleetConfig()
+        if self.cfg.min_nprocs > self.cfg.nprocs:
+            raise ValueError("min_nprocs exceeds nprocs")
+        self.base_env = dict(os.environ if env is None else env)
+        self.ckpt_dir = Path(ckpt_dir) if ckpt_dir else None
+        self.coordinator_host = coordinator_host
+        self._uses_coordinator = any(
+            "{coordinator}" in a for a in self.cmd_template
+        )
+        # One trace id for every generation (adopted from the caller's
+        # env when present), exported forward to every rank.
+        self.trace_id = self.base_env.get(TRACE_ENV) or new_trace_id()
+        self.base_env[TRACE_ENV] = self.trace_id
+        self._tel = None
+        self._trace = None
+        self._run_span = None
+        self._ranks: list[_Rank] = []
+
+    # ------------------------------------------------------------ telemetry
+
+    def _telemetry(self):
+        if self._tel is None:
+            from masters_thesis_tpu.telemetry import TelemetryRun
+
+            self._tel = TelemetryRun(
+                self.run_dir / "supervisor",
+                run_id=f"fleet-{self.run_dir.name}",
+            )
+        return self._tel
+
+    def _event(self, kind: str, **payload) -> None:
+        try:
+            self._telemetry().event(kind, **payload)
+        except Exception:
+            # The supervisor's telemetry must never kill supervision.
+            pass
+
+    def _tracer(self):
+        if self._trace is None:
+            try:
+                from masters_thesis_tpu.telemetry.trace import Tracer
+
+                tel = self._telemetry()
+                self._trace = Tracer(tel.sink, trace_id=self.trace_id)
+                tel._tracer = self._trace
+            except Exception:
+                return None
+        return self._trace
+
+    # ------------------------------------------------------------- evidence
+
+    def _rank_heartbeat_ts(self, rank_dir: Path) -> float | None:
+        """Freshest ``last_beat_ts`` under one rank's telemetry dir —
+        the PROGRESS marker (the heartbeat file's own mtime keeps
+        advancing while the main thread hangs in a dead collective)."""
+        from masters_thesis_tpu.telemetry.flightrec import HEARTBEAT_FILENAME
+
+        best = None
+        for hb in rank_dir.rglob(HEARTBEAT_FILENAME):
+            obj = _read_json(hb)
+            ts = obj.get("last_beat_ts") if obj else None
+            if ts is None:
+                try:
+                    ts = hb.stat().st_mtime
+                except OSError:
+                    continue
+            best = ts if best is None else max(best, ts)
+        return best
+
+    def _rank_crash_context(
+        self, rank_dir: Path, since_ts: float
+    ) -> tuple[str | None, int | None]:
+        from masters_thesis_tpu.telemetry.flightrec import CRASHDUMP_FILENAME
+
+        phase = epoch = None
+        for p in sorted(rank_dir.rglob(CRASHDUMP_FILENAME)):
+            dump = _read_json(p)
+            if dump and (dump.get("ts") or 0.0) >= since_ts:
+                phase, epoch = dump.get("phase"), dump.get("epoch")
+        return phase, epoch
+
+    def _verified_checkpoint(self) -> str | None:
+        from masters_thesis_tpu.train.manifest import last_verified_checkpoint
+
+        return last_verified_checkpoint(self.ckpt_dir)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _launch_generation(
+        self, gen: int, world: int, coordinator: str | None
+    ) -> None:
+        import random
+
+        gen_dir = self.run_dir / f"g{gen}"
+        env_base = dict(self.base_env)
+        env_base[ATTEMPT_ENV] = str(gen + 1)
+        env_base[GENERATION_ENV] = str(gen)
+        if coordinator:
+            env_base[COORDINATOR_ENV] = coordinator
+        else:
+            env_base.pop(COORDINATOR_ENV, None)
+        tracer = self._tracer()
+        if tracer is not None:
+            self._gen_span = tracer.start(
+                "fleet.generation", parent=self._run_span, gen=gen,
+                nprocs=world,
+            )
+            # Every rank's root span hangs off this generation span:
+            # one trace covers the supervisor and all N * generations
+            # processes it launched.
+            env_base[PARENT_SPAN_ENV] = self._gen_span.span_id
+        rng = random.Random()
+        self._ranks = []
+        for rank in range(world):
+            rank_dir = gen_dir / f"p{rank}"
+            rank_dir.mkdir(parents=True, exist_ok=True)
+            subst = {
+                "rank": rank,
+                "world": world,
+                "coordinator": coordinator or "",
+                "gen": gen,
+                "out": rank_dir,
+                "root": self.run_dir,
+            }
+            cmd = [_fill(a, subst) for a in self.cmd_template]
+            env = dict(env_base)
+            env["JAX_PROCESS_INDEX"] = str(rank)
+            env["JAX_PROCESS_COUNT"] = str(world)
+            if self.cfg.launch_stagger_s and rank:
+                time.sleep(rng.uniform(0.0, self.cfg.launch_stagger_s))
+            out_path = gen_dir / f"p{rank}.out"
+            err_path = gen_dir / f"p{rank}.err"
+            out_f = open(out_path, "wb")
+            err_f = open(err_path, "wb")
+            proc = subprocess.Popen(
+                cmd,
+                stdout=out_f,
+                stderr=err_f,
+                env=env,
+                start_new_session=True,  # killpg hits the rank's tree only
+            )
+            self._ranks.append(
+                _Rank(rank, proc, rank_dir, out_path, err_path,
+                      (out_f, err_f))
+            )
+
+    def _terminate_all(self, why: str) -> None:
+        """SIGTERM every live rank, ONE shared grace window, SIGKILL the
+        rest; reap everything. Phased so the grace is fleet-wide (N *
+        grace_s would let a 16-rank teardown take minutes)."""
+        live = [r for r in self._ranks if r.proc.poll() is None]
+        if live:
+            print(
+                f"[fleetsup] terminating {len(live)} rank(s): {why} "
+                f"(SIGTERM, {self.cfg.term_grace_s:.0f}s grace, SIGKILL)",
+                file=sys.stderr,
+                flush=True,
+            )
+        for r in live:
+            try:
+                os.killpg(r.proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        deadline = time.monotonic() + self.cfg.term_grace_s
+        for r in live:
+            timeout = max(0.0, deadline - time.monotonic())
+            try:
+                r.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(r.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for r in self._ranks:
+            if r.proc.poll() is None:
+                try:
+                    r.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+            for f in r.files:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+    # -------------------------------------------------------- one generation
+
+    def _run_generation(
+        self, gen: int, world: int, resumed_from: str | None
+    ) -> GenerationOutcome:
+        cfg = self.cfg
+        coordinator = None
+        if self._uses_coordinator:
+            from masters_thesis_tpu.utils.backend_probe import (
+                free_coordinator_address,
+            )
+
+            coordinator = free_coordinator_address(self.coordinator_host)
+        self._event(
+            "fleet_generation_started",
+            gen=gen,
+            nprocs=world,
+            coordinator=coordinator,
+            resumed_from=resumed_from,
+            cmd=shlex.join(self.cmd_template),
+        )
+        start_ts = time.time()
+        t0 = time.monotonic()
+        self._gen_span = None
+        self._launch_generation(gen, world, coordinator)
+        coord_up = coordinator is None
+        failed: _Rank | None = None
+        hang_killed = False
+        why = ""
+        try:
+            while True:
+                time.sleep(cfg.poll_interval_s)
+                rcs = {r.rank: r.proc.poll() for r in self._ranks}
+                bad = next(
+                    (r for r in self._ranks
+                     if rcs[r.rank] not in (None, 0)),
+                    None,
+                )
+                if bad is not None:
+                    failed = bad
+                    why = f"rank {bad.rank} exited rc={rcs[bad.rank]}"
+                    break
+                if all(rc == 0 for rc in rcs.values()):
+                    break  # whole fleet finished clean
+                now = time.monotonic()
+                if not coord_up and coordinator:
+                    from masters_thesis_tpu.utils.backend_probe import (
+                        coordinator_reachable,
+                    )
+
+                    coord_up = coordinator_reachable(
+                        coordinator, timeout_s=0.2
+                    )
+                    if (
+                        not coord_up
+                        and cfg.boot_timeout_s is not None
+                        and now - t0 > cfg.boot_timeout_s
+                    ):
+                        failed = self._ranks[0]
+                        why = (
+                            "coordinator never came up within "
+                            f"{cfg.boot_timeout_s:.0f}s"
+                        )
+                        break
+                if cfg.hang_timeout_s and now - t0 > cfg.hang_timeout_s:
+                    stale = self._find_hung_rank(rcs, gen)
+                    if stale is not None:
+                        failed = stale
+                        hang_killed = True
+                        why = f"rank {stale.rank} heartbeat stale"
+                        break
+        finally:
+            # Any exit from the loop — success, failure, or an exception
+            # in the supervisor itself — tears the whole generation down.
+            # On success every rank already exited 0 and this only reaps.
+            self._terminate_all(why or "generation over")
+        wall_s = time.monotonic() - t0
+        pids = [r.proc.pid for r in self._ranks]
+
+        if failed is None:
+            if self._trace is not None and self._gen_span is not None:
+                self._trace.end(self._gen_span, status="ok", nprocs=world)
+            return GenerationOutcome(
+                gen=gen, nprocs=world, ok=True, wall_s=wall_s, pids=pids
+            )
+        rc = failed.proc.poll()
+        if hang_killed or rc is None:
+            rc = None
+        phase, epoch = self._rank_crash_context(failed.dir, start_ts)
+        cls = classify_exit(
+            rc if not hang_killed else None,
+            _tail(failed.err_path),
+            hang_killed=hang_killed,
+            crash_phase=phase,
+            crash_epoch=epoch,
+        )
+        if self._trace is not None and self._gen_span is not None:
+            self._trace.end(
+                self._gen_span, status="error", failed_rank=failed.rank,
+                classification=cls.kind,
+            )
+        self._event(
+            "fleet_failure",
+            gen=gen,
+            rank=failed.rank,
+            rc=rc,
+            hang=hang_killed,
+            classification=cls.kind,
+            reason=cls.reason[:500],
+            fingerprint=cls.fingerprint,
+        )
+        print(
+            f"[fleetsup] generation {gen} failed: {why} "
+            f"({cls.kind}: {cls.reason})",
+            file=sys.stderr,
+            flush=True,
+        )
+        return GenerationOutcome(
+            gen=gen, nprocs=world, ok=False, wall_s=wall_s, pids=pids,
+            failed_rank=failed.rank, rc=rc, hang_killed=hang_killed,
+            classification=cls,
+        )
+
+    def _find_hung_rank(self, rcs: dict, gen: int) -> _Rank | None:
+        """The first still-running rank whose heartbeat is stale past
+        ``hang_timeout_s`` (or that a chaos plan wedged)."""
+        cfg = self.cfg
+        now = time.time()
+        for r in self._ranks:
+            if rcs[r.rank] is not None:
+                continue  # exited-0 ranks are done, not hung
+            if faults.fire(
+                "fleet.rank_heartbeat", rank=r.rank, gen=gen
+            ) == "wedge":
+                return r
+            ts = self._rank_heartbeat_ts(r.dir)
+            # No heartbeat at all counts from generation start (the
+            # elapsed > hang_timeout_s gate in the caller): a rank that
+            # never got far enough to beat is as gone as one that
+            # stopped.
+            if ts is None or now - ts > cfg.hang_timeout_s:
+                return r
+        return None
+
+    # ------------------------------------------------------------- the loop
+
+    def run(self) -> FleetResult:
+        cfg = self.cfg
+        result = FleetResult(
+            ok=False, verdict="retries_exhausted",
+            final_nprocs=cfg.nprocs, trace_id=self.trace_id,
+        )
+        tracer = self._tracer()
+        if tracer is not None:
+            self._run_span = tracer.start("fleet.run")
+        self._event(
+            "fleet_started",
+            nprocs=cfg.nprocs,
+            min_nprocs=cfg.min_nprocs,
+            max_relaunches_per_size=cfg.max_relaunches_per_size,
+            max_generations=cfg.max_generations,
+            hang_timeout_s=cfg.hang_timeout_s,
+            cmd=shlex.join(self.cmd_template),
+            trace_id=self.trace_id,
+        )
+        world = cfg.nprocs
+        gen = 0
+        relaunches_at_size = 0
+        last_fp: str | None = None
+        backoff = DecorrelatedBackoff(
+            cfg.backoff_s, cfg.max_backoff_s, cfg.backoff_factor
+        )
+        try:
+            while True:
+                resumed_from = self._verified_checkpoint()
+                outcome = self._run_generation(gen, world, resumed_from)
+                result.generations.append(outcome)
+                result.final_nprocs = world
+                if outcome.ok:
+                    result.ok = True
+                    result.verdict = "completed"
+                    break
+                cls = outcome.classification
+                deterministic = (
+                    cls is not None
+                    and cls.fingerprint is not None
+                    and cls.fingerprint == last_fp
+                )
+                last_fp = cls.fingerprint if cls is not None else None
+                if gen + 1 >= cfg.max_generations:
+                    result.verdict = "budget_exhausted"
+                    break
+                if (
+                    deterministic
+                    or relaunches_at_size >= cfg.max_relaunches_per_size
+                ):
+                    if world - 1 < cfg.min_nprocs:
+                        result.verdict = (
+                            "deterministic" if deterministic
+                            else "retries_exhausted"
+                        )
+                        break
+                    reason = (
+                        "deterministic host loss (fingerprint "
+                        f"{last_fp} reproduced)" if deterministic
+                        else "full-size relaunch budget spent "
+                        f"({cfg.max_relaunches_per_size})"
+                    )
+                    self._event(
+                        "fleet_resized",
+                        gen=gen + 1,
+                        from_nprocs=world,
+                        to_nprocs=world - 1,
+                        reason=reason,
+                        fingerprint=last_fp,
+                    )
+                    print(
+                        f"[fleetsup] resizing fleet {world} -> {world - 1}: "
+                        f"{reason}",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    world -= 1
+                    result.resized = True
+                    relaunches_at_size = 0
+                    # Fresh fingerprint chain at the new size: the retired
+                    # rank's failure must not instantly condemn N-1.
+                    last_fp = None
+                else:
+                    relaunches_at_size += 1
+                delay = backoff.next()
+                self._event(
+                    "fleet_relaunch",
+                    gen=gen + 1,
+                    nprocs=world,
+                    backoff_s=delay,
+                    # Re-resolved NOW, not reused from the loop top: the
+                    # dead generation may have published checkpoints the
+                    # pre-launch probe never saw (first relaunch would
+                    # otherwise always report null).
+                    resumed_from=self._verified_checkpoint(),
+                    reason=(cls.reason[:500] if cls is not None else None),
+                )
+                time.sleep(delay)
+                gen += 1
+        finally:
+            # Belt and braces: no verdict may leave orphan ranks behind,
+            # even if the supervisor itself blew up mid-generation.
+            self._terminate_all("fleet verdict")
+        if tracer is not None and self._run_span is not None:
+            tracer.end(
+                self._run_span,
+                status="ok" if result.ok else "error",
+                verdict=result.verdict,
+                generations=result.n_generations,
+                final_nprocs=result.final_nprocs,
+            )
+            self._run_span = None
+        self._event(
+            "fleet_verdict",
+            ok=result.ok,
+            verdict=result.verdict,
+            generations=result.n_generations,
+            final_nprocs=result.final_nprocs,
+            resized=result.resized,
+            trace_id=self.trace_id,
+        )
+        if self._tel is not None:
+            try:
+                self._tel.close()
+            except Exception:
+                pass
+        return result
+
+
+def _fill(arg: str, subst: dict) -> str:
+    """Substitute ``{key}`` placeholders without str.format (a worker
+    arg containing unrelated braces must pass through untouched)."""
+    for key in TEMPLATE_KEYS:
+        arg = arg.replace("{" + key + "}", str(subst[key]))
+    return arg

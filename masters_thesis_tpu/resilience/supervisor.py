@@ -48,6 +48,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Sequence
 
+from masters_thesis_tpu.resilience.backoff import DecorrelatedBackoff
 from masters_thesis_tpu.resilience.faults import ATTEMPT_ENV
 from masters_thesis_tpu.telemetry.trace import (
     PARENT_SPAN_ENV,
@@ -229,6 +230,70 @@ def _read_json(path: Path) -> dict | None:
     except (OSError, ValueError):
         return None
     return obj if isinstance(obj, dict) else None
+
+
+def classify_exit(
+    rc: int | None,
+    stderr_tail: str,
+    *,
+    hang_killed: bool = False,
+    timed_out: bool = False,
+    diverged_epoch: int | None = None,
+    crash_phase: str | None = None,
+    crash_epoch: int | None = None,
+) -> Classification:
+    """Evidence-based exit classification, shared by the single-process
+    :class:`RunSupervisor` and the fleet supervisor (which gathers the
+    same evidence per rank). The caller supplies what it read from disk:
+    the stderr tail, any divergence verdict from the child's event
+    stream, and the phase/epoch of the freshest crashdump this attempt
+    produced (both feed the crash fingerprint, so "died in checkpoint
+    publish at epoch 3" and "died in data load at epoch 0" are distinct
+    failures even with identical stderr)."""
+    if timed_out:
+        return Classification("timeout", "attempt wall-clock cap hit")
+    if hang_killed:
+        return Classification(
+            "transient", "hang: heartbeat went stale (watchdog kill)"
+        )
+    # Divergence first: the trainer HALTS on NaN but exits 0, so the
+    # verdict lives in the child's event stream, not the return code.
+    if diverged_epoch is not None:
+        return Classification(
+            "divergence",
+            f"run diverged (non-finite loss) at epoch {diverged_epoch}",
+            fingerprint=f"nan@epoch{diverged_epoch}",
+            diverged_epoch=diverged_epoch,
+        )
+    if rc == 0:
+        return Classification("success", "exited 0")
+    if rc is not None and rc < 0:
+        sig = -rc
+        name = (
+            signal.Signals(sig).name
+            if sig in signal.Signals._value2member_map_
+            else str(sig)
+        )
+        return Classification(
+            "transient", f"killed by {name} (preemption-shaped)"
+        )
+    if any(p in stderr_tail for p in TRANSIENT_PATTERNS):
+        return Classification(
+            "transient",
+            f"backend unavailable (rc={rc}): "
+            f"{_crash_line(stderr_tail)}",
+        )
+    # Unknown crash: fingerprint it; the retry loop halts when the
+    # same fingerprint reproduces (deterministic by evidence).
+    crash_line = _crash_line(stderr_tail)
+    fp = hashlib.sha1(
+        f"{rc}|{crash_line}|{crash_phase}|{crash_epoch}".encode()
+    ).hexdigest()[:12]
+    return Classification(
+        "transient",
+        f"crash (rc={rc}): {crash_line or 'no stderr'}",
+        fingerprint=fp,
+    )
 
 
 class RunSupervisor:
@@ -599,50 +664,23 @@ class RunSupervisor:
         hang_killed: bool,
         timed_out: bool,
     ) -> Classification:
-        if timed_out:
-            return Classification("timeout", "attempt wall-clock cap hit")
-        if hang_killed:
-            return Classification(
-                "transient", "hang: heartbeat went stale (watchdog kill)"
-            )
-        # Divergence first: the trainer HALTS on NaN but exits 0, so the
-        # verdict lives in the child's event stream, not the return code.
-        diverged_epoch = self._diverged_epoch(start_ts)
-        if diverged_epoch is not None:
-            return Classification(
-                "divergence",
-                f"run diverged (non-finite loss) at epoch {diverged_epoch}",
-                fingerprint=f"nan@epoch{diverged_epoch}",
-                diverged_epoch=diverged_epoch,
-            )
-        if rc == 0:
-            return Classification("success", "exited 0")
-        if rc is not None and rc < 0:
-            sig = -rc
-            name = signal.Signals(sig).name if sig in signal.Signals._value2member_map_ else str(sig)
-            return Classification(
-                "transient", f"killed by {name} (preemption-shaped)"
-            )
-        if any(p in stderr_tail for p in TRANSIENT_PATTERNS):
-            return Classification(
-                "transient",
-                f"backend unavailable (rc={rc}): "
-                f"{_crash_line(stderr_tail)}",
-            )
-        # Unknown crash: fingerprint it; the retry loop halts when the
-        # same fingerprint reproduces (deterministic by evidence).
-        crash_line = _crash_line(stderr_tail)
+        """Gather this attempt's on-disk evidence, then delegate to the
+        shared :func:`classify_exit` rules."""
+        diverged_epoch = None
+        if not timed_out and not hang_killed:
+            diverged_epoch = self._diverged_epoch(start_ts)
         phase = epoch = None
         for dump in self._crashdumps():
             if (dump.get("ts") or 0.0) >= start_ts:
                 phase, epoch = dump.get("phase"), dump.get("epoch")
-        fp = hashlib.sha1(
-            f"{rc}|{crash_line}|{phase}|{epoch}".encode()
-        ).hexdigest()[:12]
-        return Classification(
-            "transient",
-            f"crash (rc={rc}): {crash_line or 'no stderr'}",
-            fingerprint=fp,
+        return classify_exit(
+            rc,
+            stderr_tail,
+            hang_killed=hang_killed,
+            timed_out=timed_out,
+            diverged_epoch=diverged_epoch,
+            crash_phase=phase,
+            crash_epoch=epoch,
         )
 
     # ------------------------------------------------------------- the loop
@@ -667,7 +705,13 @@ class RunSupervisor:
         attempt = 0
         retries = rollbacks = 0
         lr_scale = 1.0
-        backoff = cfg.backoff_s
+        # Decorrelated jitter: with many supervised runs (or a whole
+        # fleet) restarting off the same failure, identical exponential
+        # schedules would thundering-herd the coordinator/backend.
+        # backoff_factor <= 1.0 keeps the old deterministic constant.
+        backoff_policy = DecorrelatedBackoff(
+            cfg.backoff_s, cfg.max_backoff_s, cfg.backoff_factor
+        )
         seen_fingerprints: list[str] = []
         last_divergence: str | None = None
 
@@ -737,6 +781,7 @@ class RunSupervisor:
             if retries >= cfg.max_retries:
                 result.verdict = "retries_exhausted"
                 break
+            backoff = backoff_policy.next()
             if (
                 cfg.retry_budget_s is not None
                 and time.monotonic() - t_start + backoff > cfg.retry_budget_s
@@ -754,7 +799,6 @@ class RunSupervisor:
                 flush=True,
             )
             time.sleep(backoff)
-            backoff = min(backoff * cfg.backoff_factor, cfg.max_backoff_s)
 
         if tracer is not None and self._run_span is not None:
             tracer.end(

@@ -712,7 +712,11 @@ class FleetServer:
         self._event(
             "replica_dead",
             replica=replica.name,
-            generation=generation,
+            # replica_gen, not "generation": that name is the fleet
+            # supervisor's envelope key (telemetry.events.RESERVED_KEYS)
+            # and would both fail the emit-time clash check and be
+            # misread by the aggregator's generation stitching.
+            replica_gen=generation,
             cause=cause,
             fingerprint=fingerprint,
             detail=detail,
@@ -830,7 +834,7 @@ class FleetServer:
             self._event(
                 "replica_boot_failed",
                 replica=replica.name,
-                generation=generation,
+                replica_gen=generation,
                 detail=detail,
             )
             verdict = self.restart_policy.classify(
@@ -888,7 +892,7 @@ class FleetServer:
         self._event(
             "replica_started",
             replica=replica.name,
-            generation=generation,
+            replica_gen=generation,
             restart=not initial,
             boot_s=boot_s,
             warmup_batch_ms=warm_s * 1e3,

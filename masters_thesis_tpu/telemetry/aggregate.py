@@ -20,7 +20,15 @@ folds all of them into a single fleet report:
 - **exit-status reconstruction** — per process: ``finished`` /
   ``killed`` (crashdump from a signal) / ``hung`` (crashdump from the
   hang watchdog) / ``running`` (recent activity) / ``dead`` (started,
-  never finished, no recent activity — the SIGKILL case).
+  never finished, no recent activity — the SIGKILL case) /
+  ``superseded`` (an older fleet generation the supervisor already
+  relaunched past — history, not a live failure);
+- **generation stitching** — a fleet-supervised incident leaves one
+  stream per rank PER GENERATION (``g<gen>/p<rank>``) plus the
+  supervisor's stream; the report reconstructs the attempt chain across
+  relaunches and elastic resizes (``fleet_resized``), judges liveness
+  against the LATEST generation's world size only, and rides the ONE
+  trace id the supervisor threads through every generation.
 
 Stdlib-only by contract, like :mod:`report`: the ``aggregate`` and
 ``postmortem`` CLI subcommands run on operator machines where importing a
@@ -76,16 +84,52 @@ def digest_stream(path: Path, root: Path) -> dict:
     by_kind: dict[str, list[dict]] = {}
     proc = nproc = None
     host = pid = None
+    generation = None
     for ev in events:
         by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
         if proc is None and ev.get("proc") is not None:
             proc = ev["proc"]
         if ev.get("nproc") is not None:
             nproc = max(nproc or 0, ev["nproc"])
+        if ev.get("generation") is not None:
+            # Fleet-supervised ranks tag every envelope with their
+            # generation; a stream that spans relaunches keeps the max.
+            generation = max(generation or 0, int(ev["generation"]))
         host = host or ev.get("host")
         pid = pid or ev.get("pid")
     starts = by_kind.get("run_started", [])
     started = bool(starts)
+    # Supervisor streams (fleet or single-run) finish at their VERDICT,
+    # not at run_finished — without this the postmortem flags the
+    # supervisor's own stream as a dead worker.
+    role = (
+        "supervisor"
+        if ("fleet_started" in by_kind or "supervisor_started" in by_kind)
+        else "worker"
+    )
+    fleet_verdict = (by_kind.get("fleet_verdict") or [None])[-1]
+    sup_verdict = (by_kind.get("supervisor_verdict") or [None])[-1]
+    verdict = fleet_verdict or sup_verdict
+    fleet = None
+    if "fleet_started" in by_kind:
+        gen_starts = by_kind.get("fleet_generation_started", [])
+        fleet = {
+            "generations": len(gen_starts),
+            "last_nprocs": (
+                gen_starts[-1].get("nprocs") if gen_starts else None
+            ),
+            "resizes": [
+                {k: ev.get(k) for k in
+                 ("gen", "from_nprocs", "to_nprocs", "reason",
+                  "fingerprint")}
+                for ev in by_kind.get("fleet_resized", [])
+            ],
+            "verdict": None if fleet_verdict is None else {
+                k: fleet_verdict.get(k) for k in
+                ("ok", "verdict", "generations", "final_nprocs",
+                 "resized", "trace_id")
+            },
+        }
     # Attempt linking: a supervised run APPENDS each retry to the same
     # stream, so one events.jsonl can hold several attempts — delimited by
     # run_started (trainer streams) or the envelope's attempt tag. The
@@ -122,7 +166,15 @@ def digest_stream(path: Path, root: Path) -> dict:
     spans = by_kind.get("span", [])
     trace_id = next(
         (s["trace_id"] for s in spans if s.get("trace_id")),
-        next((s["trace_id"] for s in starts if s.get("trace_id")), None),
+        next(
+            (s["trace_id"] for s in starts if s.get("trace_id")),
+            next(
+                (ev["trace_id"]
+                 for ev in by_kind.get("fleet_started", [])
+                 if ev.get("trace_id")),
+                None,
+            ),
+        ),
     )
     span_walls: dict[str, dict[int, float]] = {}
     for s in spans:
@@ -156,7 +208,14 @@ def digest_stream(path: Path, root: Path) -> dict:
         "started": started,
         "attempts": attempts,
         "resumed_from": resumed_from,
-        "finished": finished is not None,
+        "generation": generation,
+        "role": role,
+        "fleet": fleet,
+        "verdict": None if verdict is None else {
+            "ok": bool(verdict.get("ok")),
+            "verdict": verdict.get("verdict"),
+        },
+        "finished": finished is not None or verdict is not None,
         "diverged": bool(finished and finished.get("diverged")),
         "steps_per_sec": finished.get("steps_per_sec") if finished else None,
         "platform": last_start.get("platform"),
@@ -233,20 +292,65 @@ def aggregate_streams(
     for d in digests:
         d["status"] = _status(d, now, grace_s)
 
-    expected = max(
-        [d["nproc"] for d in digests if d.get("nproc")] or [len(digests)]
+    # --- generation stitching (fleet supervisor relaunch / resize) ---
+    # A fleet-supervised incident leaves one stream per rank PER
+    # GENERATION under the same root, plus the supervisor's own stream.
+    # Everything below reconstructs ONE logical run from that pile: the
+    # LATEST generation is the fleet's present; older generations are
+    # forensic history, not live failures.
+    sups = [d for d in digests if d.get("role") == "supervisor"]
+    workers = [d for d in digests if d.get("role") != "supervisor"]
+    gens = sorted(
+        {d["generation"] for d in workers if d.get("generation") is not None}
     )
-    present = {d["proc"] for d in digests if d.get("proc") is not None}
+    fleet_gen = gens[-1] if gens else None
+    if len(gens) > 1:
+        # Two generations both contain a "p0": disambiguate every worker
+        # label with its generation so rows and attribution keys stay
+        # unique across relaunches.
+        for d in workers:
+            g = d["generation"] if d.get("generation") is not None else 0
+            d["label"] = f"g{g}/{d['label']}"
+    for d in workers:
+        if (
+            fleet_gen is not None
+            and d.get("generation") is not None
+            and d["generation"] < fleet_gen
+            and d["status"] != "finished"
+        ):
+            # The fleet was relaunched past this stream: an unfinished
+            # older-generation rank is SUPERSEDED evidence — the relaunch
+            # already healed it, so it must not read as dead forever.
+            d["status"] = "superseded"
+    current = [
+        d for d in workers
+        if fleet_gen is None or d.get("generation") in (None, fleet_gen)
+    ]
+    fleet_info = next((d["fleet"] for d in sups if d.get("fleet")), None)
+    resizes = fleet_info["resizes"] if fleet_info else []
+    fleet_verdict = fleet_info["verdict"] if fleet_info else None
+    # Epoch statistics (skew, wait, straggler) compare only the CURRENT
+    # generation — a superseded rank's partial epochs would poison the
+    # shared-epoch intersection and the wait attribution.
+    stat_digests = current if fleet_gen is not None else digests
+
+    # Expected world size is the LATEST generation's: after an elastic
+    # resize the retired rank is gone by design, not missing.
+    expected_src = [d["nproc"] for d in current if d.get("nproc")]
+    if fleet_info and fleet_info.get("last_nprocs"):
+        expected_src.append(fleet_info["last_nprocs"])
+    expected = max(expected_src or [len(current) or len(digests)])
+    present = {d["proc"] for d in current if d.get("proc") is not None}
     missing = (
         sorted(set(range(expected)) - present)
-        if present and expected > len(digests)
+        if present and expected > len(current)
         else []
     )
 
     # Skew + wait attribution over the epochs EVERY stream shares — a
     # process that died at epoch 3 must not make the survivors' epochs
     # 4..N look like infinite skew.
-    walls = [d["epoch_walls"] for d in digests if d["epoch_walls"]]
+    walls = [d["epoch_walls"] for d in stat_digests if d["epoch_walls"]]
     shared = sorted(set.intersection(*map(set, walls))) if len(walls) > 1 else []
     per_epoch_skew = {
         e: max(w[e] for w in walls) - min(w[e] for w in walls)
@@ -255,7 +359,7 @@ def aggregate_streams(
     slowest_count: dict[str, int] = {}
     for e in shared:
         slowest = max(
-            (d for d in digests if e in d["epoch_walls"]),
+            (d for d in stat_digests if e in d["epoch_walls"]),
             key=lambda d: d["epoch_walls"][e],
         )
         slowest_count[slowest["label"]] = (
@@ -265,7 +369,7 @@ def aggregate_streams(
         d["label"]: sum(
             max(w[e] for w in walls) - d["epoch_walls"][e] for e in shared
         )
-        for d in digests
+        for d in stat_digests
         if d["epoch_walls"]
     }
 
@@ -273,13 +377,13 @@ def aggregate_streams(
     # span name over the epochs every emitting stream shares — so "p1 waits
     # 2s" decomposes into WHICH phase the fleet serializes on.
     span_names = sorted(
-        {n for d in digests for n in (d.get("span_walls") or {})}
+        {n for d in stat_digests for n in (d.get("span_walls") or {})}
     )
     collective_wait_by_span: dict[str, dict[str, float]] = {}
     for name in span_names:
         swalls = [
             d["span_walls"][name]
-            for d in digests
+            for d in stat_digests
             if (d.get("span_walls") or {}).get(name)
         ]
         if len(swalls) < 2:
@@ -292,7 +396,7 @@ def aggregate_streams(
                 max(w[e] for w in swalls) - d["span_walls"][name][e]
                 for e in shared_e
             )
-            for d in digests
+            for d in stat_digests
             if (d.get("span_walls") or {}).get(name)
         }
     trace_ids = sorted(
@@ -303,14 +407,14 @@ def aggregate_streams(
     if shared:
         totals = {
             d["label"]: sum(d["epoch_walls"][e] for e in shared)
-            for d in digests
+            for d in stat_digests
             if d["epoch_walls"]
         }
         worst_label = max(totals, key=totals.get)
         ordered = sorted(totals.values())
         median = ordered[len(ordered) // 2]
         slowdown = (totals[worst_label] / median - 1.0) if median > 0 else 0.0
-        worst = next(d for d in digests if d["label"] == worst_label)
+        worst = next(d for d in stat_digests if d["label"] == worst_label)
         straggler = {
             "label": worst_label,
             "proc": worst["proc"],
@@ -322,7 +426,7 @@ def aggregate_streams(
         }
 
     per_host_wall: dict[str, list[float]] = {}
-    for d in digests:
+    for d in stat_digests:
         if d["epoch_walls"] and d.get("host"):
             per_host_wall.setdefault(d["host"], []).extend(
                 d["epoch_walls"][e] for e in (shared or d["epoch_walls"])
@@ -339,7 +443,25 @@ def aggregate_streams(
             heartbeat_gaps[d["label"]] = fleet_last - last
 
     failures: list[str] = []
+    for d in sups:
+        v = d.get("verdict")
+        if v is not None and not v["ok"]:
+            detail = ""
+            if fleet_verdict is not None:
+                detail = (
+                    f" after {fleet_verdict.get('generations')} "
+                    f"generation(s), final "
+                    f"{fleet_verdict.get('final_nprocs')} rank(s)"
+                )
+            failures.append(
+                f"{d['label']} supervisor verdict "
+                f"{v['verdict'].upper()}{detail}"
+            )
     for d in digests:
+        if d.get("role") == "supervisor" or d["status"] == "superseded":
+            # Supervisors fail via their verdict (above); superseded
+            # generations already paid their failure as a relaunch.
+            continue
         if d["status"] in ("killed", "hung", "crashed", "dead"):
             crash = d.get("crashdump") or {}
             where = (
@@ -419,8 +541,20 @@ def aggregate_streams(
     return {
         "processes": digests,
         "expected_processes": expected,
-        "finished_processes": sum(d["status"] == "finished" for d in digests),
+        "finished_processes": sum(
+            d["status"] == "finished"
+            for d in digests
+            if d.get("role") != "supervisor"
+        ),
         "missing_processes": missing,
+        "fleet_generation": fleet_gen,
+        "generations": (
+            fleet_info["generations"]
+            if fleet_info and fleet_info.get("generations")
+            else (fleet_gen + 1 if fleet_gen is not None else None)
+        ),
+        "resizes": resizes,
+        "fleet_verdict": fleet_verdict,
         "epoch_skew": {
             "epochs_compared": len(shared),
             "mean_s": (
@@ -481,8 +615,19 @@ def postmortem_path(
 def _headline(report: dict) -> str:
     n = len(report["processes"])
     if report["healthy"]:
+        extra = ""
+        gens = report.get("generations")
+        if gens and gens > 1:
+            extra = f" (fleet healed across {gens} generations" + (
+                f", {len(report['resizes'])} resize(s))"
+                if report.get("resizes") else ")"
+            )
+            return (
+                f"latest generation finished clean; no live failures"
+                + extra
+            )
         return (
-            f"all {n} process(es) finished; no failures detected"
+            f"all {n} process(es) finished; no failures detected" + extra
         )
     return report["failures"][0] + (
         f" [{len(report['failures'])} finding(s); "
@@ -507,6 +652,21 @@ def render_fleet_text(report: dict, postmortem: bool = False) -> str:
         f"{report['finished_processes']}/{report['expected_processes']} "
         "finished",
     ]
+    if report.get("fleet_generation") is not None:
+        gen_line = f"generations    : {report.get('generations')}"
+        for r in report.get("resizes") or []:
+            gen_line += (
+                f" | resized {r.get('from_nprocs')}->{r.get('to_nprocs')}"
+                f" @ g{r.get('gen')} ({r.get('reason')})"
+            )
+        lines.append(gen_line)
+    if report.get("fleet_verdict"):
+        v = report["fleet_verdict"]
+        lines.append(
+            f"fleet verdict  : {'ok' if v.get('ok') else 'FAILED'} "
+            f"({v.get('verdict')}, final {v.get('final_nprocs')} rank(s), "
+            f"trace {v.get('trace_id')})"
+        )
     for d in report["processes"]:
         hb = report["heartbeat_gaps_s"].get(d["label"])
         lines.append(

@@ -1,7 +1,8 @@
 """Structured JSONL event sink — one append-only stream per run.
 
 Each event is one JSON object per line with a fixed envelope
-(``ts``/``kind``/``run``/``seq``/``host``/``pid``/``proc``/``nproc``) and a
+(``ts``/``kind``/``run``/``seq``/``host``/``pid``/``proc``/``nproc``/
+``attempt``, plus ``generation`` inside a supervised fleet) and a
 flat, kind-specific payload (schema: docs/telemetry.md). The file is flushed
 after every line: a SIGKILL mid-run (the grid runner's budget cap, a relay
 wedge watchdog) loses at most the event being written, and a resumed run
@@ -23,7 +24,25 @@ from pathlib import Path
 # Envelope keys; payload keys must not collide (enforced at emit time).
 RESERVED_KEYS = (
     "ts", "kind", "run", "seq", "host", "pid", "proc", "nproc", "attempt",
+    "generation",
 )
+
+#: Fleet generation counter (``MTT_GENERATION``), exported by the fleet
+#: supervisor for each launch: generation 0 is the first whole-fleet
+#: launch, each all-rank relaunch (same or resized world) increments it.
+GENERATION_ENV = "MTT_GENERATION"
+
+
+def current_generation() -> int | None:
+    """Fleet generation from the env; ``None`` outside a supervised
+    fleet (single-process runs never carry the key)."""
+    raw = os.environ.get(GENERATION_ENV)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 def current_attempt() -> int:
@@ -50,6 +69,7 @@ class EventSink:
         proc: int | None = None,
         nproc: int | None = None,
         attempt: int | None = None,
+        generation: int | None = None,
     ):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
@@ -57,6 +77,9 @@ class EventSink:
         self.proc = proc
         self.nproc = nproc
         self.attempt = current_attempt() if attempt is None else attempt
+        self.generation = (
+            current_generation() if generation is None else generation
+        )
         self._host = socket.gethostname()
         self._pid = os.getpid()
         self._seq = 0
@@ -101,8 +124,12 @@ class EventSink:
             "proc": self.proc,
             "nproc": self.nproc,
             "attempt": self.attempt,
-            **payload,
         }
+        # Only fleet-supervised streams carry a generation: keeping the
+        # key absent elsewhere leaves single-process streams byte-stable.
+        if self.generation is not None:
+            event["generation"] = self.generation
+        event.update(payload)
         self._seq += 1  # mtt: disable=CL502 -- _emit_locked runs only with _lock held (emit/try_emit are the sole callers)
         if self._file is None:
             self._file = open(self.path, "a", encoding="utf-8")

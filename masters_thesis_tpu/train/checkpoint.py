@@ -20,7 +20,6 @@ equivalent).
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import shutil
 import sys
@@ -35,11 +34,16 @@ import orbax.checkpoint as ocp
 from masters_thesis_tpu.models.objectives import ModelSpec
 from masters_thesis_tpu.resilience import faults
 from masters_thesis_tpu.train import flatparams
-from masters_thesis_tpu.utils import atomic_write_text
 
-#: Content-checksum manifest written INSIDE the checkpoint tree, so it
-#: rides the same staged-swap renames as the data it describes.
-MANIFEST_NAME = "MANIFEST.json"
+# Manifest machinery lives in the stdlib-only train.manifest module (the
+# fleet supervisor verifies checkpoints on hosts where importing jax can
+# hang); re-exported here for the historical import path.
+from masters_thesis_tpu.train.manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    verify_checkpoint,
+    write_manifest as _write_manifest,
+)
+from masters_thesis_tpu.utils import atomic_write_text, fsync_path
 
 
 class CorruptCheckpointError(RuntimeError):
@@ -119,52 +123,14 @@ def save_checkpoint(
         _publish(ckpt_dir, tag)
         if faults.fire("checkpoint.post_publish", tag=tag) == "corrupt":
             _corrupt_tree(path, seed=faults.corruption_seed())
+    # Publish barrier: non-zero ranks must not race ahead (into the next
+    # save's staging reset, or a preemption-window exit) while rank 0 is
+    # still mid-rotation — a fleet-level kill landing in that window
+    # would otherwise see a torn publish that NO rank was responsible
+    # for finishing. No-op single-process.
+    from masters_thesis_tpu.parallel.mesh import fleet_barrier
 
-
-def _write_manifest(tree: Path) -> None:
-    """Write ``MANIFEST.json`` (sha256 + size per file) into ``tree``,
-    fsync'ing so the checksums are durable before the publish rename."""
-    files = {}
-    for p in sorted(tree.rglob("*")):
-        if p.is_file() and p.name != MANIFEST_NAME:
-            files[str(p.relative_to(tree))] = {
-                "sha256": hashlib.sha256(p.read_bytes()).hexdigest(),
-                "size": p.stat().st_size,
-            }
-    atomic_write_text(
-        tree / MANIFEST_NAME,
-        json.dumps({"algo": "sha256", "files": files}, indent=2),
-        fsync=True,
-    )
-
-
-def verify_checkpoint(path: Path, require_manifest: bool = False) -> bool:
-    """Check a checkpoint tree against its content manifest.
-
-    By default, trees without a manifest (pre-manifest checkpoints)
-    verify True — backward compatible, no protection; the training
-    restore path keeps this lenient grandfathering. With
-    ``require_manifest=True`` a manifest-less tree FAILS: the serve
-    hot-swap path uses strict mode so an unverifiable tree (torn write,
-    pre-manifest save, or anything an attacker could stage without
-    checksums) can never be swapped into traffic. A manifest whose files
-    are missing, truncated, or checksum-mismatched fails either way.
-    """
-    path = Path(path)
-    manifest_path = path / MANIFEST_NAME
-    if not manifest_path.exists():
-        return path.exists() and not require_manifest
-    try:
-        manifest = json.loads(manifest_path.read_text())
-        for rel, want in manifest["files"].items():
-            p = path / rel
-            if not p.is_file() or p.stat().st_size != want["size"]:
-                return False
-            if hashlib.sha256(p.read_bytes()).hexdigest() != want["sha256"]:
-                return False
-    except (OSError, ValueError, KeyError, TypeError):
-        return False
-    return True
+    fleet_barrier(f"checkpoint.publish.{tag}")
 
 
 def _corrupt_tree(path: Path, seed: int) -> None:
@@ -194,7 +160,16 @@ def _publish(ckpt_dir: Path, tag: str) -> None:
     sidecar) instead of deleted: restore falls back to it when the latest
     tree fails content verification. A crash mid-rotation can at worst
     leave an incomplete ``.prev`` pair — never a damaged primary, since
-    recovery re-runs the staging swap."""
+    recovery re-runs the staging swap.
+
+    Callers must run this on rank 0 only (save_checkpoint and
+    _run_recovery both gate on ``jax.process_index() == 0``): under
+    shared multi-host storage, two processes racing the rotation could
+    rename the same tree twice. The directory is fsync'd after the
+    rotation and again after the staging swap so the rename ORDER is
+    what reaches stable storage — a power cut must never surface the new
+    tree as live while the ``.prev`` rotation it depends on is still
+    only in the page cache."""
     path = ckpt_dir / tag
     prev = ckpt_dir / f"{tag}.prev"
     prev_sidecar = ckpt_dir / f"{tag}.prev.json"
@@ -206,8 +181,15 @@ def _publish(ckpt_dir: Path, tag: str) -> None:
         sidecar = ckpt_dir / f"{tag}.json"
         if sidecar.exists():
             sidecar.replace(prev_sidecar)
+        fsync_path(ckpt_dir)
+    # The most exposed instant of the protocol: the rotation has moved
+    # the old checkpoint aside but the staged tree is not yet live. A
+    # kill here must leave .prev restorable and the staged pair intact
+    # for recovery — the torn-mid-publish chaos test fires exactly here.
+    faults.fire("checkpoint.mid_publish", tag=tag)
     (ckpt_dir / f"{tag}.new").rename(path)
     (ckpt_dir / f"{tag}.json.new").replace(ckpt_dir / f"{tag}.json")
+    fsync_path(ckpt_dir)
 
 
 def _recover_staged(ckpt_dir: Path, tag: str) -> None:

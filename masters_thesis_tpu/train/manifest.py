@@ -1,0 +1,96 @@
+"""Checkpoint content manifests — stdlib-only, importable on wedged hosts.
+
+The manifest (sha256 + size per file, written INSIDE the checkpoint tree
+so it rides the same staged-publish renames as the data it describes) is
+consumed from two very different places:
+
+- the trainer/serve restore paths (``train/checkpoint.py``, which owns
+  the orbax machinery and re-exports these names), and
+- the fleet supervisor, which must pick the last *verified* checkpoint to
+  relaunch a dead fleet from — on a host where importing jax/orbax can
+  hang on the exact wedge that killed the fleet.
+
+Hence this module's contract: no jax, no orbax, no numpy — hashing and
+json only, like the telemetry CLIs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from masters_thesis_tpu.utils import atomic_write_text
+
+#: Content-checksum manifest written INSIDE the checkpoint tree, so it
+#: rides the same staged-swap renames as the data it describes.
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def write_manifest(tree: Path) -> None:
+    """Write ``MANIFEST.json`` (sha256 + size per file) into ``tree``,
+    fsync'ing so the checksums are durable before the publish rename."""
+    files = {}
+    for p in sorted(Path(tree).rglob("*")):
+        if p.is_file() and p.name != MANIFEST_NAME:
+            files[str(p.relative_to(tree))] = {
+                "sha256": hashlib.sha256(p.read_bytes()).hexdigest(),
+                "size": p.stat().st_size,
+            }
+    atomic_write_text(
+        Path(tree) / MANIFEST_NAME,
+        json.dumps({"algo": "sha256", "files": files}, indent=2),
+        fsync=True,
+    )
+
+
+def verify_checkpoint(path: Path, require_manifest: bool = False) -> bool:
+    """Check a checkpoint tree against its content manifest.
+
+    By default, trees without a manifest (pre-manifest checkpoints)
+    verify True — backward compatible, no protection; the training
+    restore path keeps this lenient grandfathering. With
+    ``require_manifest=True`` a manifest-less tree FAILS: the serve
+    hot-swap path uses strict mode so an unverifiable tree (torn write,
+    pre-manifest save, or anything an attacker could stage without
+    checksums) can never be swapped into traffic. A manifest whose files
+    are missing, truncated, or checksum-mismatched fails either way.
+    """
+    path = Path(path)
+    manifest_path = path / MANIFEST_NAME
+    if not manifest_path.exists():
+        return path.exists() and not require_manifest
+    try:
+        manifest = json.loads(manifest_path.read_text())
+        for rel, want in manifest["files"].items():
+            p = path / rel
+            if not p.is_file() or p.stat().st_size != want["size"]:
+                return False
+            if hashlib.sha256(p.read_bytes()).hexdigest() != want["sha256"]:
+                return False
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    return True
+
+
+def last_verified_checkpoint(
+    ckpt_dir: Path | str | None, tag: str = "last"
+) -> str | None:
+    """The newest manifest-verified restore point under ``ckpt_dir``:
+    ``<tag>`` if its (tree, sidecar) pair is complete and verifies, else
+    the ``<tag>.prev`` rotation, else ``None``.
+
+    Filesystem + hashing only — this is what the fleet supervisor reports
+    as ``resumed_from`` before relaunching; the child trainer's own
+    restore (which can additionally finish an interrupted publish) is
+    still the authority on what actually loads.
+    """
+    if ckpt_dir is None:
+        return None
+    ckpt_dir = Path(ckpt_dir)
+    for name in (tag, f"{tag}.prev"):
+        tree = ckpt_dir / name
+        sidecar = ckpt_dir / f"{name}.json"
+        if tree.is_dir() and sidecar.is_file() and verify_checkpoint(tree):
+            return str(tree)
+    return None

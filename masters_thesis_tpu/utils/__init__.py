@@ -15,6 +15,7 @@ from masters_thesis_tpu.utils.compilation_cache import (
 from masters_thesis_tpu.utils.io import (
     atomic_publish,
     atomic_write_text,
+    fsync_path,
     wait_until,
 )
 
@@ -27,6 +28,7 @@ __all__ = [
     "atomic_write_text",
     "distributed_client_initialized",
     "enable_persistent_compilation_cache",
+    "fsync_path",
     "multihost_rank",
     "probe_tpu_backend",
     "wait_until",

@@ -284,6 +284,50 @@ def distributed_client_initialized() -> bool:
     return getattr(distributed.global_state, "client", None) is not None
 
 
+def free_coordinator_address(host: str = "127.0.0.1") -> str:
+    """A ``host:port`` the OS just confirmed free, for a fresh
+    ``jax.distributed`` coordinator.
+
+    The fleet supervisor allocates a NEW address per fleet generation:
+    the old coordinator died with the old rank 0, and its port can
+    linger in TIME_WAIT — rebinding it from a relaunched rank 0 races
+    the kernel. Jax-free (a plain socket bind)."""
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return f"{host}:{s.getsockname()[1]}"
+
+
+def coordinator_reachable(address: str, timeout_s: float = 1.0) -> bool:
+    """TCP-connect probe of a coordinator address — jax-free, so the
+    fleet supervisor can tell 'rank 0 never opened the coordinator
+    service' (boot failure) from 'ranks are up but wedged' (hang)."""
+    import socket
+
+    host, _, port = address.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=timeout_s):
+            return True
+    except (OSError, ValueError):
+        return False
+
+
+def wait_for_coordinator(
+    address: str, timeout_s: float, interval_s: float = 0.1
+) -> bool:
+    """Poll :func:`coordinator_reachable` until it answers or the boot
+    budget runs out."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if coordinator_reachable(address, timeout_s=min(1.0, interval_s * 5)):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(interval_s)
+
+
 def _xla_backend_initialized() -> bool:
     """Whether any XLA backend is already live (so querying it is free)."""
     try:

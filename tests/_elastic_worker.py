@@ -1,0 +1,131 @@
+"""Fleet rank for the REAL elastic-fleet chaos test.
+
+One rank of an N-process ``jax.distributed`` CPU fleet launched by the
+:class:`~masters_thesis_tpu.resilience.fleetsup.FleetSupervisor`. Joins
+the generation's coordinator via :func:`parallel.mesh.join_fleet` (the
+supervisor exports ``MTT_COORDINATOR`` + ``JAX_PROCESS_INDEX``/``COUNT``
+per generation), runs a real Trainer.fit with epoch-granular
+checkpointing and auto-resume against a SHARED checkpoint dir, then
+rank 0 dumps the final params to ``<state>/params.npz``.
+
+Chaos: when ``MTT_CHAOS_KILL_RANK`` names this rank and this is
+generation 0, the rank installs an in-process fault plan that SIGKILLs
+it right after epoch ``MTT_CHAOS_KILL_EPOCH`` is dispatched (before the
+checkpoint save) — a host dying mid-epoch. The supervisor must then
+tear down the survivors and relaunch the whole fleet from the last
+manifest-verified checkpoint; tests/test_fleetsup.py asserts the final
+params are bit-identical to a fault-free fleet's.
+
+Usage (as a supervisor cmd template):
+    python tests/_elastic_worker.py --state <shared> --out {out} \\
+        [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+# The package is run from the repo, not installed: python <this file> puts
+# tests/ (not the repo root) on sys.path.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # beat the axon sitecustomize
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--state", type=Path, required=True,
+                    help="shared dir: data + checkpoints + final params")
+    ap.add_argument("--out", type=Path, required=True,
+                    help="this rank's per-generation telemetry dir")
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port minted per generation by the supervisor")
+    args = ap.parse_args()
+    args.out.mkdir(parents=True, exist_ok=True)
+
+    gen = int(os.environ.get("MTT_GENERATION", "0") or 0)
+    kill_rank = os.environ.get("MTT_CHAOS_KILL_RANK")
+    kill_epoch = int(os.environ.get("MTT_CHAOS_KILL_EPOCH", "1") or 1)
+
+    from masters_thesis_tpu.parallel import join_fleet
+
+    rank, world = join_fleet(coordinator_address=args.coordinator or None)
+    assert jax.process_count() == world, jax.process_count()
+
+    if kill_rank is not None and int(kill_rank) == rank and gen == 0:
+        # SIGKILL self right after the chosen epoch is dispatched but
+        # BEFORE its checkpoint save: the relaunch must redo this epoch
+        # from the last published checkpoint. Installed in-process (not
+        # via MTT_FAULT_PLAN) because the supervisor exports one env to
+        # every rank and only this rank may die.
+        from masters_thesis_tpu.resilience import faults
+        from masters_thesis_tpu.resilience.faults import FaultPlan, FaultSpec
+
+        faults.install_plan(FaultPlan([
+            FaultSpec(point="trainer.epoch_dispatched", kind="kill",
+                      attempt=None, match={"epoch": kill_epoch}),
+        ]))
+
+    from masters_thesis_tpu.data.pipeline import (
+        FinancialWindowDataModule,
+        bootstrap_synthetic,
+    )
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.telemetry import TelemetryRun
+    from masters_thesis_tpu.train import Trainer
+
+    # Rank 0 generates the shared dataset; the rest block on the
+    # completion marker (same rendezvous as the distributed test). The
+    # cache persists across generations, so a relaunch skips regen.
+    data_dir = args.state / "data"
+    bootstrap_synthetic(data_dir, n_stocks=4, n_samples=3820, seed=0)
+    dm = FinancialWindowDataModule(
+        data_dir, lookback_window=16, target_window=8, stride=24,
+        batch_size=1,
+    )
+    dm.prepare_data(verbose=False)
+    dm.setup()
+
+    telemetry = TelemetryRun(args.out / "telemetry")
+    rec = telemetry.attach_flight_recorder(heartbeat_interval_s=0.2)
+    rec.beat(phase="setup")
+    trainer = Trainer(
+        max_epochs=args.epochs,
+        gradient_clip_val=5.0,
+        check_val_every_n_epoch=1,
+        checkpoint_every_n_epochs=1,
+        strategy="tpu_xla",
+        enable_progress_bar=False,
+        enable_model_summary=False,
+        seed=0,
+        ckpt_dir=args.state / "ckpts",
+        resume="auto",
+        telemetry=telemetry,
+    )
+    spec = ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        learning_rate=1e-2,
+    )
+    result = trainer.fit(spec, dm)
+    if rank == 0:
+        leaves = jax.tree_util.tree_leaves(jax.device_get(result.params))
+        np.savez(
+            args.state / "params.npz",
+            **{f"p{i}": np.asarray(a) for i, a in enumerate(leaves)},
+        )
+    telemetry.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

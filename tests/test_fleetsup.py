@@ -1,0 +1,618 @@
+"""Fleet supervisor: all-rank relaunch, elastic resize, hang watchdog,
+generation-stitched postmortem, decorrelated backoff, and the
+torn-mid-publish checkpoint rotation.
+
+The fast tests drive the REAL FleetSupervisor over the jax-free
+``fleet-worker`` simulant (subprocess fleets, ~a second per generation).
+The slow class at the bottom runs an actual 4-process ``jax.distributed``
+CPU fleet through a mid-epoch SIGKILL and proves the relaunch resumes
+bit-identically from the published checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.resilience.__main__ import (
+    _fleet_expected_value,
+    _fleet_shard,
+)
+from masters_thesis_tpu.resilience.backoff import DecorrelatedBackoff
+from masters_thesis_tpu.resilience.fleetsup import (
+    FleetConfig,
+    FleetSupervisor,
+)
+from masters_thesis_tpu.telemetry.aggregate import postmortem_path
+from masters_thesis_tpu.telemetry.events import read_events
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ shard bounds
+
+
+class TestShardBounds:
+    def test_partition_covers_everything_once(self):
+        from masters_thesis_tpu.parallel.mesh import (
+            balanced_shard_sizes,
+            shard_bounds,
+        )
+
+        for n in (0, 1, 5, 64, 101):
+            for world in (1, 2, 3, 4, 7):
+                bounds = [shard_bounds(n, world, r) for r in range(world)]
+                # Contiguous, ordered, exactly covering [0, n).
+                assert bounds[0][0] == 0
+                assert bounds[-1][1] == n
+                for (_, hi), (lo2, _) in zip(bounds, bounds[1:]):
+                    assert hi == lo2
+                sizes = balanced_shard_sizes(n, world)
+                assert sum(sizes) == n
+                assert max(sizes) - min(sizes) <= 1
+
+    def test_rebalance_after_resize_still_covers(self):
+        # The elastic-resize contract: shards are a pure function of
+        # (n, world, rank), so survivors re-cover everything at N-1.
+        from masters_thesis_tpu.parallel.mesh import shard_bounds
+
+        n = 64
+        for world in (4, 3, 2, 1):
+            covered = set()
+            for r in range(world):
+                lo, hi = shard_bounds(n, world, r)
+                covered.update(range(lo, hi))
+            assert covered == set(range(n))
+
+    def test_errors(self):
+        from masters_thesis_tpu.parallel.mesh import shard_bounds
+
+        with pytest.raises(ValueError):
+            shard_bounds(8, 0, 0)
+        with pytest.raises(ValueError):
+            shard_bounds(8, 2, 2)
+        with pytest.raises(ValueError):
+            shard_bounds(8, 2, -1)
+
+    def test_jax_free_worker_mirror_stays_in_lockstep(self):
+        from masters_thesis_tpu.parallel.mesh import shard_bounds
+
+        for n in (0, 1, 5, 64, 101):
+            for world in (1, 2, 3, 4, 7):
+                for r in range(world):
+                    assert _fleet_shard(n, world, r) == shard_bounds(
+                        n, world, r
+                    )
+
+
+# ------------------------------------------------------------------ backoff
+
+
+class _HighRng:
+    def uniform(self, a, b):
+        return b
+
+
+class _LowRng:
+    def uniform(self, a, b):
+        return a
+
+
+class TestDecorrelatedBackoff:
+    def test_first_delay_is_base(self):
+        assert DecorrelatedBackoff(0.5, 60.0).next() == 0.5
+
+    def test_factor_one_degrades_to_constant_base(self):
+        # The deterministic test configs (backoff_factor=1.0) must keep
+        # their exact sleep schedule: jitter range collapses to a point.
+        b = DecorrelatedBackoff(0.05, 60.0, factor=1.0)
+        assert [b.next() for _ in range(5)] == [0.05] * 5
+
+    def test_delays_stay_within_base_and_cap(self):
+        import random
+
+        b = DecorrelatedBackoff(1.0, 8.0, factor=3.0,
+                                rng=random.Random(7))
+        delays = [b.next() for _ in range(50)]
+        assert all(1.0 <= d <= 8.0 for d in delays)
+        # With factor 3 and cap 8 the chain must actually reach the cap
+        # region — decorrelated, not stuck at base.
+        assert max(delays) > 4.0
+
+    def test_upper_bound_grows_decorrelated(self):
+        b = DecorrelatedBackoff(1.0, 100.0, factor=2.0, rng=_HighRng())
+        assert [b.next() for _ in range(4)] == [1.0, 2.0, 4.0, 8.0]
+        b2 = DecorrelatedBackoff(1.0, 3.0, factor=2.0, rng=_HighRng())
+        assert [b2.next() for _ in range(4)] == [1.0, 2.0, 3.0, 3.0]
+
+    def test_lower_bound_resets_chain_memory(self):
+        b = DecorrelatedBackoff(1.0, 100.0, factor=4.0, rng=_LowRng())
+        # A lucky low draw keeps the next upper bound small: the chain
+        # decorrelates instead of marching deterministically upward.
+        assert [b.next() for _ in range(3)] == [1.0, 1.0, 1.0]
+
+    def test_reset_forgets_history(self):
+        b = DecorrelatedBackoff(1.0, 100.0, factor=2.0, rng=_HighRng())
+        b.next(), b.next(), b.next()
+        b.reset()
+        assert b.next() == 1.0
+
+    def test_invalid_config_raises(self):
+        with pytest.raises(ValueError):
+            DecorrelatedBackoff(-1.0, 5.0)
+        with pytest.raises(ValueError):
+            DecorrelatedBackoff(1.0, -5.0)
+
+
+# ------------------------------------------------- envelope generation tag
+
+
+class TestGenerationEnvelope:
+    def test_generation_tag_only_when_fleet_sets_env(
+        self, tmp_path, monkeypatch
+    ):
+        from masters_thesis_tpu.telemetry import TelemetryRun
+
+        monkeypatch.delenv("MTT_GENERATION", raising=False)
+        tel = TelemetryRun(tmp_path / "plain")
+        ev = tel.event("probe")
+        tel.close()
+        # Single-process streams stay byte-stable: no generation key.
+        assert "generation" not in ev
+
+        monkeypatch.setenv("MTT_GENERATION", "2")
+        tel = TelemetryRun(tmp_path / "fleet")
+        ev = tel.event("probe")
+        tel.close()
+        assert ev["generation"] == 2
+
+    def test_generation_is_reserved_in_payloads(self, tmp_path):
+        from masters_thesis_tpu.telemetry import TelemetryRun
+
+        tel = TelemetryRun(tmp_path)
+        with pytest.raises(ValueError):
+            tel.event("probe", generation=1)
+        tel.close()
+
+
+# --------------------------------------------------- simulated fleet runs
+
+
+def _fleet_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(_REPO)
+    return env
+
+
+def _worker_cmd(state: Path, epochs: int, *extra: str) -> list[str]:
+    return [
+        sys.executable, "-m", "masters_thesis_tpu.resilience",
+        "fleet-worker", "--state", str(state), "--out", "{out}",
+        "--epochs", str(epochs), "--items", "64", "--sleep-s", "0.05",
+        *extra,
+    ]
+
+
+def _fast_cfg(**over) -> FleetConfig:
+    kw = dict(
+        nprocs=2, min_nprocs=1, max_relaunches_per_size=2,
+        backoff_s=0.05, backoff_factor=1.0, term_grace_s=2.0,
+        poll_interval_s=0.05,
+    )
+    kw.update(over)
+    return FleetConfig(**kw)
+
+
+def _sup_events(run_dir: Path) -> dict[str, list[dict]]:
+    events = read_events(run_dir / "supervisor" / "events.jsonl")
+    by_kind: dict[str, list[dict]] = {}
+    for ev in events:
+        by_kind.setdefault(ev["kind"], []).append(ev)
+    return by_kind
+
+
+def _assert_no_orphans(result) -> None:
+    # Every pid the supervisor ever launched must be gone (reaped by the
+    # supervisor itself — they were its direct children).
+    for gen in result.generations:
+        for pid in gen.pids:
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+
+
+class TestFleetKillRelaunch:
+    def test_rank_sigkill_relaunches_whole_fleet_and_resumes(
+        self, tmp_path
+    ):
+        epochs = 5
+        state = tmp_path / "state"
+        result = FleetSupervisor(
+            _worker_cmd(state, epochs, "--crash-rank", "1", "--at", "1",
+                        "--crash-kind", "kill"),
+            run_dir=tmp_path / "run",
+            cfg=_fast_cfg(),
+            env=_fleet_env(),
+        ).run()
+
+        assert result.ok and result.verdict == "completed"
+        assert result.n_generations == 2 and not result.resized
+        _assert_no_orphans(result)
+
+        # Bit-identical resume: the atomic progress commit means every
+        # epoch lands in the history exactly once and the rolling value
+        # matches a fault-free run's.
+        obj = json.loads((state / "progress.json").read_text())
+        assert [e[3] for e in obj["history"]] == list(range(epochs))
+        assert obj["value"] == _fleet_expected_value(epochs)
+        # Generation is threaded through the committed history too:
+        # the relaunch really ran as generation 1.
+        assert sorted({e[1] for e in obj["history"]}) == [0, 1]
+
+        by_kind = _sup_events(tmp_path / "run")
+        assert len(by_kind["fleet_started"]) == 1
+        fail = by_kind["fleet_failure"][0]
+        assert fail["rank"] == 1 and fail["rc"] == -9
+        assert fail["classification"] == "transient"
+        assert by_kind["fleet_relaunch"][0]["gen"] == 1
+        verdict = by_kind["fleet_verdict"][-1]
+        assert verdict["ok"] and verdict["generations"] == 2
+
+    def test_generation_tag_and_single_trace_across_generations(
+        self, tmp_path
+    ):
+        state = tmp_path / "state"
+        result = FleetSupervisor(
+            _worker_cmd(state, 4, "--crash-rank", "1", "--at", "1",
+                        "--crash-kind", "kill"),
+            run_dir=tmp_path / "run",
+            cfg=_fast_cfg(),
+            env=_fleet_env(),
+        ).run()
+        assert result.ok and result.n_generations == 2
+
+        # Every envelope in a g1 worker stream carries generation=1.
+        g1_stream = next((tmp_path / "run" / "g1").rglob("events.jsonl"))
+        evs = read_events(g1_stream)
+        assert evs and all(ev.get("generation") == 1 for ev in evs)
+
+        # ONE trace id spans the supervisor and both generations.
+        report = postmortem_path(tmp_path / "run")
+        assert report["exit_code"] == 0
+        assert report["trace_ids"] == [result.trace_id]
+        assert report["generations"] == 2
+
+
+class TestFleetHangWatchdog:
+    def test_hung_rank_restarts_fleet(self, tmp_path):
+        state = tmp_path / "state"
+        result = FleetSupervisor(
+            _worker_cmd(state, 4, "--hang-rank", "1", "--at", "1"),
+            run_dir=tmp_path / "run",
+            cfg=_fast_cfg(hang_timeout_s=1.5),
+            env=_fleet_env(),
+        ).run()
+        assert result.ok and result.n_generations == 2
+        _assert_no_orphans(result)
+        fail = _sup_events(tmp_path / "run")["fleet_failure"][0]
+        assert fail["hang"] is True and fail["rank"] == 1
+        assert fail["classification"] == "transient"
+        obj = json.loads((state / "progress.json").read_text())
+        assert [e[3] for e in obj["history"]] == list(range(4))
+
+
+class TestFleetElasticResize:
+    def test_deterministic_rank_loss_resizes_4_to_3_and_completes(
+        self, tmp_path
+    ):
+        epochs = 4
+        state = tmp_path / "state"
+        result = FleetSupervisor(
+            _worker_cmd(state, epochs, "--crash-rank", "3", "--at", "1",
+                        "--crash-mode", "always"),
+            run_dir=tmp_path / "run",
+            cfg=_fast_cfg(nprocs=4),
+            env=_fleet_env(),
+        ).run()
+
+        # gen 0 fails (fingerprint A), gen 1 fails (A again ->
+        # deterministic) -> resize to 3 -> gen 2 has no rank 3 and
+        # completes.
+        assert result.ok and result.resized
+        assert result.final_nprocs == 3 and result.n_generations == 3
+        _assert_no_orphans(result)
+
+        by_kind = _sup_events(tmp_path / "run")
+        resized = by_kind["fleet_resized"][0]
+        assert resized["from_nprocs"] == 4 and resized["to_nprocs"] == 3
+        assert "deterministic" in resized["reason"]
+        assert resized["fingerprint"]
+
+        # Shards re-balance from the new world size: the final
+        # generation's 3 ranks still cover all 64 items exactly once.
+        final_gen = max(
+            int(ln.split()[0])
+            for ln in (state / "shards.log").read_text().splitlines()
+        )
+        covered: list[int] = []
+        for ln in (state / "shards.log").read_text().splitlines():
+            gen, world, rank, lo, hi = map(int, ln.split())
+            if gen == final_gen:
+                assert world == 3
+                covered.extend(range(lo, hi))
+        assert sorted(covered) == list(range(64))
+
+        # Work history is complete despite the resize.
+        obj = json.loads((state / "progress.json").read_text())
+        assert [e[3] for e in obj["history"]] == list(range(epochs))
+
+        # Acceptance: the postmortem stitches the whole incident into
+        # ONE trace id across all three generations and exits 0.
+        report = postmortem_path(tmp_path / "run")
+        assert report["exit_code"] == 0
+        assert report["trace_ids"] == [result.trace_id]
+        assert report["generations"] == 3
+        assert len(report["resizes"]) == 1
+        assert report["fleet_verdict"]["ok"]
+
+    def test_deterministic_loss_at_floor_halts_with_no_orphans(
+        self, tmp_path
+    ):
+        state = tmp_path / "state"
+        result = FleetSupervisor(
+            _worker_cmd(state, 4, "--crash-rank", "1", "--at", "1",
+                        "--crash-mode", "always"),
+            run_dir=tmp_path / "run",
+            cfg=_fast_cfg(nprocs=2, min_nprocs=2),
+            env=_fleet_env(),
+        ).run()
+        assert not result.ok and result.verdict == "deterministic"
+        assert result.n_generations == 2 and not result.resized
+        _assert_no_orphans(result)
+        verdict = _sup_events(tmp_path / "run")["fleet_verdict"][-1]
+        assert verdict["ok"] is False
+        assert verdict["verdict"] == "deterministic"
+        # The failed-fleet postmortem reports the supervisor's verdict.
+        report = postmortem_path(tmp_path / "run")
+        assert report["exit_code"] == 2
+        assert any("DETERMINISTIC" in f for f in report["failures"])
+
+
+# ------------------------------------- aggregate generation stitching
+
+
+def _write_stream(dir: Path, events: list[dict]) -> None:
+    dir.mkdir(parents=True, exist_ok=True)
+    with open(dir / "events.jsonl", "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _ev(seq, kind, *, proc, nproc, gen, attempt=1, ts=1000.0, **payload):
+    ev = {
+        "ts": ts + seq * 0.1, "kind": kind, "run": "r", "seq": seq,
+        "host": "h", "pid": (100 + proc) if proc is not None else 99,
+        "proc": proc, "nproc": nproc, "attempt": attempt,
+    }
+    if gen is not None:
+        ev["generation"] = gen
+    ev.update(payload)
+    return ev
+
+
+class TestAggregateGenerationStitching:
+    def _fleet_root(self, tmp_path, *, second_gen_nprocs: int,
+                    second_gen_procs: list[int]) -> Path:
+        root = tmp_path / "run"
+        # Generation 0: two ranks, both torn down unfinished.
+        for p in (0, 1):
+            _write_stream(root / "g0" / f"p{p}", [
+                _ev(0, "run_started", proc=p, nproc=2, gen=0,
+                    trace_id="t1"),
+                _ev(1, "epoch", proc=p, nproc=2, gen=0, epoch=0,
+                    wall_s=0.1),
+            ])
+        # Generation 1 (after the resize): the survivors finish.
+        for p in second_gen_procs:
+            _write_stream(root / "g1" / f"p{p}", [
+                _ev(0, "run_started", proc=p, nproc=second_gen_nprocs,
+                    gen=1, attempt=2, ts=1100.0, trace_id="t1"),
+                _ev(1, "run_finished", proc=p, nproc=second_gen_nprocs,
+                    gen=1, attempt=2, ts=1100.0),
+            ])
+        _write_stream(root / "supervisor", [
+            _ev(0, "fleet_started", proc=None, nproc=None, gen=None,
+                nprocs=2, trace_id="t1"),
+            _ev(1, "fleet_generation_started", proc=None, nproc=None,
+                gen=None, nprocs=2),
+            _ev(2, "fleet_failure", proc=None, nproc=None, gen=None,
+                rank=1, rc=3, classification="deterministic"),
+            _ev(3, "fleet_resized", proc=None, nproc=None, gen=None,
+                from_nprocs=2, to_nprocs=second_gen_nprocs,
+                reason="deterministic host loss", fingerprint="abc",
+                ts=1050.0),
+            _ev(4, "fleet_generation_started", proc=None, nproc=None,
+                gen=None, nprocs=second_gen_nprocs, ts=1050.0),
+            _ev(5, "fleet_verdict", proc=None, nproc=None, gen=None,
+                ok=True, verdict="completed", generations=2,
+                final_nprocs=second_gen_nprocs, trace_id="t1",
+                ts=1100.0),
+        ])
+        return root
+
+    def test_retired_rank_is_not_missing_after_resize(self, tmp_path):
+        # nproc shrinks 2 -> 1 across generations: the retired rank 1
+        # must read as SUPERSEDED history, not as dead-forever or as a
+        # missing process in the latest generation.
+        root = self._fleet_root(tmp_path, second_gen_nprocs=1,
+                                second_gen_procs=[0])
+        report = postmortem_path(root, now=1100.0 + 3600.0, grace_s=30.0)
+        assert report["exit_code"] == 0, report["failures"]
+        assert report["missing_processes"] == []
+        assert report["expected_processes"] == 1
+        statuses = {d["label"]: d["status"] for d in report["processes"]}
+        assert statuses["g0/p0"] == "superseded"
+        assert statuses["g0/p1"] == "superseded"
+        assert statuses["g1/p0"] == "finished"
+        assert len(report["resizes"]) == 1
+        assert report["fleet_verdict"]["ok"]
+        assert report["trace_ids"] == ["t1"]
+        assert report["generations"] == 2
+
+    def test_genuinely_missing_rank_in_latest_generation_still_flags(
+        self, tmp_path
+    ):
+        # Same shape but the latest generation EXPECTS 2 ranks and only
+        # p0 left a stream: that rank really is missing.
+        root = self._fleet_root(tmp_path, second_gen_nprocs=2,
+                                second_gen_procs=[0])
+        report = postmortem_path(root, now=1100.0 + 3600.0, grace_s=30.0)
+        assert report["exit_code"] == 2
+        assert report["missing_processes"] == [1]
+        assert any("p1" in f and "no event stream" in f
+                   for f in report["failures"])
+
+
+# ----------------------------------------- torn-mid-publish checkpoint
+
+
+class TestTornMidPublish:
+    def _save_inline(self, ckpt_dir: Path, epoch: int) -> None:
+        from masters_thesis_tpu.models.objectives import ModelSpec
+        from masters_thesis_tpu.train.checkpoint import save_checkpoint
+
+        spec = ModelSpec(objective="mse", hidden_size=8, num_layers=1,
+                         dropout=0.0, learning_rate=1e-2)
+        save_checkpoint(
+            ckpt_dir, "last", {"w": np.full((64,), float(epoch))}, {},
+            spec, meta={"epoch": epoch},
+        )
+
+    def test_kill_mid_publish_leaves_prev_verified(self, tmp_path):
+        ckpt_dir = tmp_path / "ckpts"
+        self._save_inline(ckpt_dir, 1)
+
+        # Second save killed at checkpoint.mid_publish: the rotation has
+        # moved last -> last.prev but the staged tree is not yet live —
+        # the single most exposed instant of the publish protocol.
+        code = (
+            "import numpy as np\n"
+            "from masters_thesis_tpu.models.objectives import ModelSpec\n"
+            "from masters_thesis_tpu.train.checkpoint import save_checkpoint\n"
+            "spec = ModelSpec(objective='mse', hidden_size=8,"
+            " num_layers=1, dropout=0.0, learning_rate=1e-2)\n"
+            f"save_checkpoint({str(ckpt_dir)!r}, 'last',"
+            " {'w': np.full((64,), 2.0)}, {}, spec, meta={'epoch': 2})\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(_REPO)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["MTT_FAULT_PLAN"] = json.dumps(
+            [{"point": "checkpoint.mid_publish", "kind": "kill"}]
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, cwd=_REPO,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == -9, proc.stderr
+
+        # Torn layout: rotation done, staged pair intact, primary gone.
+        assert not (ckpt_dir / "last").exists()
+        assert (ckpt_dir / "last.prev").is_dir()
+        assert (ckpt_dir / "last.new").is_dir()
+        assert (ckpt_dir / "last.json.new").is_file()
+
+        # The jax-free fleet-supervisor view: the .prev rotation is a
+        # manifest-verified resume point even mid-tear.
+        from masters_thesis_tpu.train.manifest import (
+            last_verified_checkpoint,
+            verify_checkpoint,
+        )
+
+        found = last_verified_checkpoint(ckpt_dir)
+        assert found == str(ckpt_dir / "last.prev")
+        assert verify_checkpoint(Path(found))
+
+        # Restore finishes the staged swap (the pair was complete and
+        # fsync'd before the rotation began) and yields save #2; the
+        # previous-good rotation survives as the fallback.
+        from masters_thesis_tpu.train.checkpoint import restore_checkpoint
+
+        params, _, _, meta = restore_checkpoint(ckpt_dir, "last")
+        assert meta["epoch"] == 2
+        np.testing.assert_array_equal(
+            np.asarray(params["w"]), np.full((64,), 2.0)
+        )
+        assert verify_checkpoint(ckpt_dir / "last")
+        assert (ckpt_dir / "last.prev").is_dir()
+        assert not (ckpt_dir / "last.new").exists()
+
+
+# --------------------------------------- REAL 4-rank elastic fleet (slow)
+
+
+@pytest.mark.slow
+class TestFleetElastic4RankReal:
+    """An actual ``jax.distributed`` CPU fleet (4 processes, 1 device
+    each) supervised end-to-end: SIGKILL one rank mid-epoch, the fleet
+    relaunches from the last manifest-verified checkpoint, and the final
+    params are bit-identical to a fault-free 4-rank fleet's."""
+
+    def _run_fleet(self, tmp_path: Path, name: str, chaos: bool):
+        worker = _REPO / "tests" / "_elastic_worker.py"
+        state = tmp_path / name / "state"
+        state.mkdir(parents=True)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["PYTHONPATH"] = str(_REPO)
+        if chaos:
+            env["MTT_CHAOS_KILL_RANK"] = "1"
+            env["MTT_CHAOS_KILL_EPOCH"] = "1"
+        sup = FleetSupervisor(
+            [
+                sys.executable, str(worker), "--state", str(state),
+                "--out", "{out}", "--coordinator", "{coordinator}",
+                "--epochs", "3",
+            ],
+            run_dir=tmp_path / name / "run",
+            cfg=FleetConfig(
+                nprocs=4, min_nprocs=1, max_relaunches_per_size=2,
+                backoff_s=0.1, backoff_factor=1.0, term_grace_s=5.0,
+                poll_interval_s=0.2, hang_timeout_s=180.0,
+            ),
+            env=env,
+            ckpt_dir=state / "ckpts",
+        )
+        return sup.run(), state
+
+    def test_sigkill_mid_epoch_resumes_bit_identical(self, tmp_path):
+        clean, clean_state = self._run_fleet(tmp_path, "clean",
+                                             chaos=False)
+        assert clean.ok and clean.n_generations == 1, clean.verdict
+
+        chaos, chaos_state = self._run_fleet(tmp_path, "chaos",
+                                             chaos=True)
+        assert chaos.ok, chaos.verdict
+        assert chaos.n_generations == 2 and not chaos.resized
+        _assert_no_orphans(chaos)
+
+        # The relaunch resumed from a manifest-verified checkpoint.
+        by_kind = _sup_events(tmp_path / "chaos" / "run")
+        relaunch = by_kind["fleet_relaunch"][0]
+        assert relaunch["resumed_from"] is not None
+        assert relaunch["resumed_from"].endswith(("last", "last.prev"))
+
+        ref = np.load(clean_state / "params.npz")
+        got = np.load(chaos_state / "params.npz")
+        assert set(ref.files) == set(got.files)
+        for key in ref.files:
+            np.testing.assert_array_equal(ref[key], got[key])

@@ -73,6 +73,12 @@ echo "== resilience classify (unit) =="
 python -m masters_thesis_tpu.resilience classify --rc -15 \
     | grep '"kind": "transient"' >/dev/null || fail=1
 
+# 3b'. fleet supervisor: hermetic 2-rank fleet, one rank SIGKILLed
+#      mid-epoch -> whole-fleet relaunch resumes bit-identically; a
+#      deterministic rank loss -> elastic resize to 1 rank (jax-free).
+echo "== resilience fleet selfcheck =="
+python -m masters_thesis_tpu.resilience fleet --selfcheck || fail=1
+
 # 3c. serving: jax-free smoke of the request path (queue/admission/
 #     deadline/breaker/canary with a fake engine), then the serve
 #     preflight on the hermetic 8-device virtual CPU mesh — every predict
