@@ -1275,6 +1275,7 @@ def main() -> None:
         from masters_thesis_tpu.analysis.concurrency import lint_concurrency
         from masters_thesis_tpu.analysis.contracts import lint_contracts
         from masters_thesis_tpu.analysis.findings import format_report
+        from masters_thesis_tpu.analysis.spmd import lint_spmd
 
         pkg_root = Path(masters_thesis_tpu.__file__).parent
         static = lint_concurrency([pkg_root], package_root=pkg_root)
@@ -1283,10 +1284,24 @@ def main() -> None:
             package_root=pkg_root,
             schema_path=pkg_root / "analysis" / "event_schema.json",
         )
+        # Pass 4: a rank-divergent collective schedule wedges the very
+        # fleet the benchmark is about to time.
+        static += lint_spmd(
+            [
+                pkg_root / "train",
+                pkg_root / "parallel",
+                pkg_root / "resilience",
+                pkg_root / "telemetry",
+            ],
+            package_root=pkg_root,
+        )
         if static:
             print(format_report(static), file=sys.stderr)
             sys.exit(2)
-        print("preflight: concurrency + contract lint ok", file=sys.stderr)
+        print(
+            "preflight: concurrency + contract + spmd lint ok",
+            file=sys.stderr,
+        )
 
         # Then the tracelint trace-time audit: a recompile / transfer /
         # sharding regression makes every number below meaningless, so

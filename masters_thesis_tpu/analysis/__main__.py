@@ -3,12 +3,19 @@
 Pass 1 (AST lint) over the given paths (default: the installed package),
 then Pass 2 (trace-time audit) on a hermetic 8-device virtual CPU mesh.
 Pass 3 — static concurrency lint (``--concurrency``, CL5xx) and the
-event-schema contract check (``--contracts``, EC6xx) — is opt-in from
-this CLI and gated by tools/check.sh; passing either flag runs *only*
-the requested Pass-3 checks (jax never imports, so it is fast enough
-for a pre-commit hook). ``--emit-schema`` regenerates the
-``analysis/event_schema.json`` lockfile. Exits non-zero iff there are
-findings, so every mode gates CI.
+event-schema contract check (``--contracts``, EC6xx) — and Pass 4 —
+the SPMD divergence lint (``--spmd``, DV7xx, over the
+train/parallel/resilience/telemetry stack) — are opt-in from this CLI
+and gated by tools/check.sh; passing any of those flags runs *only* the
+requested static checks (jax never imports, so they are fast enough for
+a pre-commit hook). ``--emit-schema`` regenerates the
+``analysis/event_schema.json`` lockfile.
+
+Exit codes (documented contract, see docs/analysis.md): 0 — no
+unsuppressed findings; 1 — at least one unsuppressed finding. With
+``--json`` the static passes also *include* suppressed findings, each
+marked ``"suppressed": true`` — they never affect the exit code, but CI
+can audit the suppression inventory from the same artifact.
 """
 
 from __future__ import annotations
@@ -95,6 +102,12 @@ def main(argv: list[str] | None = None) -> int:
         "(EC601-EC603; checks the lockfile when linting the package)",
     )
     parser.add_argument(
+        "--spmd",
+        action="store_true",
+        help="run only the Pass-4 SPMD divergence lint (DV701-DV705) "
+        "over the train/parallel/resilience/telemetry stack",
+    )
+    parser.add_argument(
         "--emit-schema",
         action="store_true",
         help="regenerate analysis/event_schema.json from the emitter "
@@ -121,35 +134,62 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 0
 
-    if args.concurrency or args.contracts:
-        pass3: list = []
+    if args.concurrency or args.contracts or args.spmd:
+        # --json keeps suppressed findings (marked) for CI's suppression
+        # inventory; they never count toward the exit code.
+        include_suppressed = args.json
+        static: list = []
         if args.concurrency:
             from masters_thesis_tpu.analysis.concurrency import (
                 lint_concurrency,
             )
 
-            pass3.extend(
-                lint_concurrency(paths, package_root=package_root)
+            static.extend(
+                lint_concurrency(
+                    paths,
+                    package_root=package_root,
+                    include_suppressed=include_suppressed,
+                )
             )
         if args.contracts:
             from masters_thesis_tpu.analysis.contracts import (
                 lint_contracts,
             )
 
-            pass3.extend(
+            static.extend(
                 lint_contracts(
                     paths,
                     package_root=package_root,
                     schema_path=lockfile if not args.paths else None,
+                    include_suppressed=include_suppressed,
+                )
+            )
+        if args.spmd:
+            from masters_thesis_tpu.analysis.spmd import lint_spmd
+
+            # The SPMD stack: where collectives are issued (train/
+            # parallel), supervised (resilience), and chained into the
+            # runtime schedule audit (telemetry).
+            spmd_paths = args.paths or [
+                package_root / "train",
+                package_root / "parallel",
+                package_root / "resilience",
+                package_root / "telemetry",
+            ]
+            static.extend(
+                lint_spmd(
+                    spmd_paths,
+                    package_root=package_root,
+                    include_suppressed=include_suppressed,
                 )
             )
         from masters_thesis_tpu.analysis.findings import format_report
 
-        pass3 = sorted(
-            set(pass3), key=lambda f: (f.path, f.line, f.rule, f.message)
+        static = sorted(
+            set(static), key=lambda f: (f.path, f.line, f.rule, f.message)
         )
-        print(format_report(pass3, as_json=args.json))
-        return 1 if pass3 else 0
+        print(format_report(static, as_json=args.json))
+        return 1 if any(not f.suppressed for f in static) else 0
 
     findings = []
     if not args.skip_lint:

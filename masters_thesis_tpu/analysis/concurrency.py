@@ -1176,9 +1176,16 @@ def _rule_cl505(
 
 
 def lint_concurrency(
-    paths: list[Path | str], package_root: Path | str | None = None
+    paths: list[Path | str],
+    package_root: Path | str | None = None,
+    include_suppressed: bool = False,
 ) -> list[Finding]:
-    """Run CL501–CL505 (+ the SP001 hygiene scan) over files/directories."""
+    """Run CL501–CL505 (+ the SP001 hygiene scan) over files/directories.
+
+    ``include_suppressed=True`` keeps suppression-matched findings,
+    marked via ``Finding.suppressed``, instead of dropping them — the
+    ``--json`` CI surface audits the suppression inventory that way.
+    """
     paths = [Path(p) for p in paths]
     if package_root is None:
         package_root = next((p for p in paths if p.is_dir()), None)
@@ -1253,10 +1260,14 @@ def lint_concurrency(
         sup = sup_cache.get(f.path, {})
         if not is_suppressed(f, sup):
             out.append(f)
+        elif include_suppressed:
+            out.append(dataclasses.replace(f, suppressed=True))
     for path, src in sorted(by_path.items()):
         for f in suppression_findings(src, path):
             if not is_suppressed(f, sup_cache.get(path, {})):
                 out.append(f)
+            elif include_suppressed:
+                out.append(dataclasses.replace(f, suppressed=True))
 
     seen: set[tuple[str, str, int, str]] = set()
     unique: list[Finding] = []

@@ -43,6 +43,7 @@ prove, it does not flag. ``# mtt: disable=EC60x -- reason`` suppresses.
 from __future__ import annotations
 
 import ast
+import dataclasses
 import json
 from pathlib import Path
 
@@ -606,11 +607,14 @@ def lint_contracts(
     paths: list[Path | str],
     package_root: Path | str | None = None,
     schema_path: Path | str | None = None,
+    include_suppressed: bool = False,
 ) -> list[Finding]:
     """Run EC601–EC603 over files/directories.
 
     ``schema_path``: lockfile to diff against (EC603); ``None`` skips the
     drift check (used when linting ad-hoc paths rather than the package).
+    ``include_suppressed=True`` keeps suppression-matched findings
+    (marked ``Finding.suppressed``) for the ``--json`` CI surface.
     """
     trees, consts, sources = _parse(paths, package_root)
     emitted = _collect_emitters(trees, consts)
@@ -703,11 +707,12 @@ def lint_contracts(
         str(p): suppressed_rules_by_line(sources[m])
         for m, (p, _t) in trees.items()
     }
-    out = [
-        f
-        for f in findings
-        if not is_suppressed(f, sup_by_path.get(f.path, {}))
-    ]
+    out: list[Finding] = []
+    for f in findings:
+        if not is_suppressed(f, sup_by_path.get(f.path, {})):
+            out.append(f)
+        elif include_suppressed:
+            out.append(dataclasses.replace(f, suppressed=True))
     return sorted(out, key=lambda f: (f.path, f.line, f.rule, f.message))
 
 

@@ -161,6 +161,38 @@ RULES: dict[str, tuple[str, str]] = {
         "--emit-schema and review the diff)",
         "contract",
     ),
+    # DV7xx: SPMD divergence lint (analysis/spmd.py) — host-divergent
+    # values (rank, env, wall clock, unseeded RNG, per-host sizes)
+    # steering the collective schedule, the canonical multi-host wedge.
+    "DV701": (
+        "rank-divergent control flow guards a collective / fleet_barrier: "
+        "only one branch (or a host-divergent early exit) reaches it, so "
+        "ranks disagree on whether the collective runs",
+        "spmd / deadlock",
+    ),
+    "DV702": (
+        "collective-order divergence: both branches of host-divergent "
+        "control flow reach collectives, but in different order or kind — "
+        "ranks issue mismatched schedules",
+        "spmd / deadlock",
+    ),
+    "DV703": (
+        "host-divergent value flows into a collective operand or a traced "
+        "array shape — per-rank shapes/operands break the SPMD program "
+        "contract",
+        "spmd / correctness",
+    ),
+    "DV704": (
+        "nondeterminism on the checkpoint publish/resume path (wall clock, "
+        "unseeded RNG, unsorted set/dir iteration) — breaks bit-identical "
+        "multi-rank resume",
+        "spmd / determinism",
+    ),
+    "DV705": (
+        "rank-0-only side effect not fenced by a named barrier in the same "
+        "function — other ranks can race past the mutation",
+        "spmd / race",
+    ),
     # SP0xx: suppression hygiene (enforced by the Pass-3 file scan).
     "SP001": (
         "suppression without justification: '# mtt: disable=<RULE>' "
@@ -185,10 +217,15 @@ class Finding:
     message: str
     path: str = "<trace>"
     line: int = 0
+    #: True when a per-line suppression matched this finding. Suppressed
+    #: findings are dropped from text reports and exit codes; ``--json``
+    #: keeps them (marked) so CI can audit the suppression inventory.
+    suppressed: bool = False
 
     def format(self) -> str:
         loc = f"{self.path}:{self.line}" if self.line else self.path
-        return f"{loc}: {self.rule} {self.message}"
+        mark = " [suppressed]" if self.suppressed else ""
+        return f"{loc}: {self.rule} {self.message}{mark}"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.telemetry.schedule import record_collective
 
 DATA_AXIS = "data"
 
@@ -210,6 +211,10 @@ def fleet_barrier(name: str) -> None:
     induces in a real collective, and what the fleet supervisor's
     hang watchdog must convert into an all-rank relaunch.
     """
+    # Chain the entry BEFORE the fault point / sync: a rank wedged inside
+    # the barrier has already published the schedule entry it is stuck
+    # on, so the cross-rank audit can name it from the heartbeat alone.
+    record_collective("barrier", name=name)
     faults.fire("dist.barrier", name=name)
     try:
         if jax.process_count() <= 1:

@@ -240,8 +240,8 @@ def fire(point: str, **ctx: Any) -> str | None:
         os.kill(os.getpid(), signal.SIGTERM)
         # Give a SIGTERM handler (flight recorder dump + re-delivery) time
         # to run; if none is installed the default action already killed us.
-        deadline = time.monotonic() + 10.0
-        while time.monotonic() < deadline:
+        deadline = time.monotonic() + 10.0  # mtt: disable=DV704 -- chaos preempt path: the process is being killed, nothing it computes past here reaches a checkpoint
+        while time.monotonic() < deadline:  # mtt: disable=DV704 -- same dying-process grace loop; timing here cannot affect resume determinism
             time.sleep(0.1)
         os._exit(143)
     if spec.kind == "kill":

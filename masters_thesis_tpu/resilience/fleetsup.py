@@ -62,6 +62,10 @@ from masters_thesis_tpu.resilience.supervisor import (
     classify_exit,
 )
 from masters_thesis_tpu.telemetry.events import GENERATION_ENV
+from masters_thesis_tpu.telemetry.schedule import (
+    audit_schedules,
+    read_rank_schedules,
+)
 from masters_thesis_tpu.telemetry.trace import (
     PARENT_SPAN_ENV,
     TRACE_ENV,
@@ -202,6 +206,39 @@ class FleetSupervisor:
         except Exception:
             # The supervisor's telemetry must never kill supervision.
             pass
+
+    def _audit_schedule(self, gen: int) -> dict:
+        """Cross-check the generation's per-rank collective schedules.
+
+        Runs on EVERY generation verdict, pass or fail: a generation the
+        exit codes call healthy can still have issued divergent
+        schedules (a rank that skipped a barrier and happened not to
+        wedge yet), and a condemned one gets its diagnosis attached to
+        the relaunch decision. Best-effort by contract — forensics must
+        never kill supervision.
+        """
+        try:
+            snaps = read_rank_schedules(self.run_dir / f"g{gen}")
+            audit = audit_schedules(snaps)
+        except Exception:
+            return {"ok": True, "verdict": "unavailable"}
+        self._event(
+            "schedule_audit",
+            gen=gen,
+            ok=audit["ok"],
+            verdict=audit["verdict"],
+            divergent_rank=audit.get("divergent_rank"),
+            step=audit.get("step"),
+            detail=audit.get("detail"),
+        )
+        if not audit["ok"]:
+            print(
+                f"[fleetsup] g{gen} collective schedule DIVERGED: "
+                f"{audit.get('detail')}",
+                file=sys.stderr,
+                flush=True,
+            )
+        return audit
 
     def _tracer(self):
         if self._trace is None:
@@ -528,6 +565,7 @@ class FleetSupervisor:
                 resumed_from = self._verified_checkpoint()
                 outcome = self._run_generation(gen, world, resumed_from)
                 result.generations.append(outcome)
+                self._audit_schedule(gen)
                 result.final_nprocs = world
                 if outcome.ok:
                     result.ok = True

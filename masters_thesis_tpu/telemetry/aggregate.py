@@ -47,6 +47,7 @@ from masters_thesis_tpu.telemetry.flightrec import (
     HEARTBEAT_FILENAME,
 )
 from masters_thesis_tpu.telemetry.report import EVENTS_FILENAME
+from masters_thesis_tpu.telemetry.schedule import audit_schedules
 
 # A process whose last activity is within this window of "now" is treated
 # as still running rather than dead (live-run inspection vs postmortem).
@@ -191,6 +192,22 @@ def digest_stream(path: Path, root: Path) -> dict:
         crashdump = {"reason": crash_events[-1].get("reason"),
                      "path": crash_events[-1].get("path")}
     heartbeat = _read_json(path.parent / HEARTBEAT_FILENAME)
+    # Collective-schedule snapshot: prefer whichever record saw the most
+    # entries — a crashdump taken after the last heartbeat is fresher,
+    # and the flushed event stream survives sidecar reaping.
+    schedule = None
+    for doc in (heartbeat, crashdump):
+        snap = (doc or {}).get("collective_schedule")
+        if snap and snap.get("n", 0) > (schedule or {}).get("n", 0):
+            schedule = snap
+    for ev in by_kind.get("collective_schedule", []):
+        snap = {
+            "n": ev.get("n"),
+            "chain": ev.get("chain"),
+            "tail": ev.get("tail") or [],
+        }
+        if snap["n"] and snap["n"] > (schedule or {}).get("n", 0):
+            schedule = snap
     try:
         rel = str(path.parent.relative_to(root))
     except ValueError:
@@ -244,6 +261,7 @@ def digest_stream(path: Path, root: Path) -> dict:
             "epoch": heartbeat.get("epoch"),
             "beats": heartbeat.get("beats"),
         },
+        "schedule": schedule,
     }
 
 
@@ -496,6 +514,33 @@ def aggregate_streams(
                and d["status"] != "finished" for d in digests):
             failures.append(failures_note)
 
+    # --- collective-schedule audit (runtime half of analysis Pass 4) ---
+    # Bitwise cross-check of each CURRENT-generation rank's schedule
+    # hash chain: a wedged fleet whose ranks issued different collective
+    # schedules gets a diagnosis (divergent rank, step, both schedules)
+    # instead of a heartbeat timeout.
+    schedule_audit = audit_schedules(
+        {
+            d["label"]: d.get("schedule")
+            for d in (current if fleet_gen is not None else workers)
+        }
+    )
+    if not schedule_audit["ok"]:
+        chains = ", ".join(
+            f"{label} {v['chain'][:16]}…({v['n']} entries)"
+            for label, v in sorted(schedule_audit["ranks"].items())
+        )
+        scheds = "; ".join(
+            f"{label}: [{', '.join(entries[-4:])}]"
+            for label, entries in sorted(
+                (schedule_audit.get("schedules") or {}).items()
+            )
+        )
+        failures.append(
+            f"collective schedule DIVERGED — {schedule_audit['detail']} "
+            f"| chains: {chains}" + (f" | tails: {scheds}" if scheds else "")
+        )
+
     # Fleet utilization: the hot program's static cost × the fleet's step
     # rate, with the comms side fed by the wait attribution above — the
     # mean fraction of shared-epoch wall each process spent blocked in the
@@ -578,6 +623,7 @@ def aggregate_streams(
         "utilization": fleet_util,
         "straggler": straggler,
         "heartbeat_gaps_s": heartbeat_gaps,
+        "collective_schedule": schedule_audit,
         "failures": failures,
         "healthy": not failures,
     }
@@ -734,6 +780,23 @@ def render_fleet_text(report: dict, postmortem: bool = False) -> str:
             lines.append(
                 "utilization    : n/a (backend reported no cost model)"
             )
+    sched = report.get("collective_schedule")
+    if sched is not None and sched.get("verdict") != "insufficient":
+        per_rank = ", ".join(
+            f"{label} {v['chain'][:12]}…({v['n']})"
+            for label, v in sorted((sched.get("ranks") or {}).items())
+        )
+        lines.append(
+            f"collectives    : {sched['verdict']}"
+            + (f" | {per_rank}" if per_rank else "")
+        )
+        if sched.get("verdict") in ("diverged", "lagging"):
+            lines.append(f"  {sched.get('detail')}")
+        for label, entries in sorted(
+            (sched.get("schedules") or {}).items()
+        ):
+            tail = ", ".join(entries[-4:]) if entries else "<empty>"
+            lines.append(f"  {label} schedule tail: {tail}")
     s = report["straggler"]
     if s is not None:
         lines.append(

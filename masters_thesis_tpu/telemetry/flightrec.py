@@ -39,6 +39,7 @@ import traceback
 from pathlib import Path
 
 from masters_thesis_tpu.telemetry.run import process_identity
+from masters_thesis_tpu.telemetry.schedule import GLOBAL_SCHEDULE
 
 CRASHDUMP_FILENAME = "crashdump.json"
 HEARTBEAT_FILENAME = "heartbeat.json"
@@ -249,6 +250,9 @@ class FlightRecorder:
                 "threads": _all_thread_stacks(),
                 "ring": list(self._ring),
             }
+            sched = GLOBAL_SCHEDULE.snapshot()
+            if sched["n"]:
+                dump["collective_schedule"] = sched
             _atomic_write_json(self.crashdump_path, dump)  # mtt: disable=CL503 -- _dump_lock exists precisely to serialize crashdump I/O
             self._write_heartbeat(crashdump=str(self.crashdump_path))  # mtt: disable=CL503 -- same serialized-forensics contract as the dump write
             if self.sink is not None:
@@ -283,6 +287,12 @@ class FlightRecorder:
 
     def _write_heartbeat(self, **extra) -> None:
         try:
+            # The schedule chain rides the heartbeat: the heartbeat
+            # thread keeps publishing while the main thread is wedged in
+            # a collective — exactly when the cross-rank audit needs it.
+            sched = GLOBAL_SCHEDULE.snapshot()
+            if sched["n"]:
+                extra.setdefault("collective_schedule", sched)
             _atomic_write_json(
                 self.heartbeat_path,
                 {
@@ -376,6 +386,20 @@ class FlightRecorder:
         self._thread.join(timeout=2.0)
         self._phase = "closed"
         self._write_heartbeat(closed=True)
+        # Publish the final schedule chain into the flushed stream: the
+        # heartbeat sidecar can be reaped, the event line survives for
+        # the postmortem's cross-rank audit.
+        sched = GLOBAL_SCHEDULE.snapshot()
+        if sched["n"] and self.sink is not None:
+            try:
+                self.sink.try_emit(
+                    "collective_schedule",
+                    n=sched["n"],
+                    chain=sched["chain"],
+                    tail=sched["tail"],
+                )
+            except Exception:
+                pass  # forensics must never kill the run
         for signum, prev in self._prev_handlers.items():
             try:
                 signal.signal(signum, prev)

@@ -240,6 +240,13 @@ def _run_recovery(ckpt_dir: Path, tag: str) -> None:
             lambda: not staging.exists() and not staged_sidecar.exists(),
             60.0,
         )
+    # Fence the recovery (DV705): without this barrier a non-zero process
+    # whose staging check raced ahead of process 0's rename could read the
+    # pre-recovery tree and resume from a different epoch. The polling
+    # wait above bounds the stall; the barrier makes the ordering exact.
+    from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+    fleet_barrier(f"checkpoint.recover.{tag}")
 
 
 def _candidates(ckpt_dir: Path, tag: str) -> list[tuple[Path, Path]]:

@@ -48,6 +48,7 @@ from masters_thesis_tpu.telemetry import (
     ProfilerWindow,
     TelemetryRun,
 )
+from masters_thesis_tpu.telemetry.schedule import record_collective
 from masters_thesis_tpu.train import checkpoint as ckpt_lib
 from masters_thesis_tpu.train.logging import TensorBoardLogger
 from masters_thesis_tpu.train.flatparams import (
@@ -750,6 +751,12 @@ class Trainer:
             # nothing about it is checkpointed yet — a kill here loses
             # exactly this epoch's work (the chaos tests' preemption site).
             faults.fire("trainer.epoch_dispatched", epoch=epoch)
+            # One schedule entry per dispatched epoch program: the flat
+            # gradient pmean over the data axis. Host-memory hash update
+            # only — no fence, no I/O (hot-loop contract holds).
+            record_collective(
+                "pmean", name="grads.flat", axes=(DATA_AXIS,), step=epoch
+            )
             total_steps += steps_per_epoch
             # 'lr-Adam' matches the reference's LearningRateMonitor scalar
             # tag (reference: train.py:162-165 names it lr-<optimizer>).

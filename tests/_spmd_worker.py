@@ -1,0 +1,77 @@
+"""Simulated SPMD rank for the collective-schedule audit tests.
+
+Not a pytest module (no ``test_`` prefix): tests/test_spmd_lint.py spawns
+2 of these as a simulated fleet — jax-free, so the divergence scenario
+exercises exactly the forensic path a wedged DCN mesh needs. The SAME
+file doubles as the static fixture: the injected rank-divergent branch
+below is what ``lint_spmd`` must catch (DV701), and what the runtime
+hash-chain audit must name by rank and step when it actually runs.
+
+Usage: python tests/_spmd_worker.py <root> <rank> <world> <scenario>
+
+Scenarios:
+
+- ``healthy``   — every rank issues the same 4-step schedule
+  (pmean + barrier per step); chains match bitwise.
+- ``divergent`` — rank 1 skips the step-2 barrier via an env-derived
+  rank guard; the audit must name p1 and the fork entry.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+
+def fleet_barrier(name: str) -> None:
+    """Jax-free stand-in for parallel.mesh.fleet_barrier: records the
+    schedule entry exactly like the real one (same chain vocabulary)."""
+    from masters_thesis_tpu.telemetry.schedule import record_collective
+
+    record_collective("barrier", name=name)
+
+
+def main() -> None:
+    root, rank, world, scenario = (
+        Path(sys.argv[1]),
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    os.environ["JAX_PROCESS_INDEX"] = str(rank)
+    os.environ["JAX_PROCESS_COUNT"] = str(world)
+
+    from masters_thesis_tpu.telemetry import TelemetryRun
+    from masters_thesis_tpu.telemetry.schedule import record_collective
+
+    tel = TelemetryRun(root / f"p{rank}", run_id=f"spmd-p{rank}")
+    rec = tel.attach_flight_recorder(heartbeat_interval_s=0.05)
+    rec.beat(phase="setup")
+    tel.event(
+        "run_started", platform="sim", n_devices=1, strategy="spmd-sim",
+        epoch_mode="scan", steps_per_epoch=1, max_epochs=4, start_epoch=0,
+        objective="mse", trainer="fleet", seed=0,
+    )
+    # Host-divergent identity, exactly as a real rank would derive it —
+    # the taint source the static lint must track into the guard below.
+    proc = int(os.environ["JAX_PROCESS_INDEX"])
+    for step in range(4):
+        rec.beat(phase="train", epoch=step)
+        record_collective("pmean", name="grads.flat", step=step)
+        if scenario == "divergent" and proc == 1 and step == 2:
+            # The injected SPMD bug: one rank's control flow skips a
+            # barrier every other rank blocks in. mtt --spmd flags this
+            # line (DV701); at runtime the hash chains fork here.
+            continue
+        fleet_barrier(f"epoch.{step}")
+    tel.event(
+        "run_finished", epochs=4, total_steps=4, steps_per_sec=40.0,
+        diverged=False, best_val=0.5, epoch_compiles=1, eval_compiles=0,
+    )
+    tel.close()
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

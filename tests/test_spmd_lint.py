@@ -1,0 +1,703 @@
+"""Pass-4 SPMD divergence lint (DV7xx) + the collective-schedule audit.
+
+Each DV rule gets a seeded fixture proving it fires, a near-identical
+clean twin proving precision, and a suppressed variant. The runtime
+half gets unit coverage of the hash chain and the cross-rank audit,
+plus the acceptance scenario: a simulated 2-rank fleet where an
+injected rank-divergent branch is caught statically AND the postmortem
+exits 2 naming the divergent rank, the fork entry, and both chains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from masters_thesis_tpu.analysis.spmd import lint_spmd
+from masters_thesis_tpu.telemetry.schedule import (
+    CollectiveSchedule,
+    audit_schedules,
+    read_rank_schedules,
+)
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_PKG_ROOT = _REPO_ROOT / "masters_thesis_tpu"
+_WORKER = _REPO_ROOT / "tests" / "_spmd_worker.py"
+
+
+def _lint(tmp_path: Path, source: str, name: str = "fix.py", **kwargs):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint_spmd([tmp_path], **kwargs)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------------- DV701
+
+
+def test_dv701_rank_branch_guards_barrier(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def publish(tag):
+            if jax.process_index() == 0:
+                fleet_barrier(f"publish.{tag}")
+        """,
+    )
+    assert _rules(findings) == {"DV701"}
+    assert "only one side" in findings[0].message
+
+
+def test_dv701_env_early_exit_skips_schedule(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import os
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def run():
+            if os.environ.get("MTT_SKIP"):
+                return
+            fleet_barrier("epoch")
+        """,
+    )
+    assert _rules(findings) == {"DV701"}
+    assert "early exit" in findings[0].message
+
+
+def test_dv701_tainted_loop_bound(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from jax import lax
+
+
+        def reduce_all(shards):
+            for shard in range(len(jax.local_devices())):
+                lax.psum(shard, "data")
+        """,
+    )
+    # The tainted loop var is also the psum operand, so DV703 rides along.
+    assert "DV701" in _rules(findings)
+    assert "trip counts" in [
+        f for f in findings if f.rule == "DV701"
+    ][0].message
+
+
+def test_dv701_clean_uniform_guard(tmp_path):
+    # process_count() is uniform across ranks — the single-process guard
+    # inside fleet_barrier itself must never fire.
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from jax.experimental import multihost_utils
+
+
+        def fleet_barrier(name):
+            if jax.process_count() <= 1:
+                return
+            multihost_utils.sync_global_devices(name)
+        """,
+    )
+    assert findings == []
+
+
+def test_dv701_clean_barrier_outside_gate(tmp_path):
+    # Rank-gated work with the barrier OUTSIDE the branch: every rank
+    # reaches the same schedule — no divergence.
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def publish(tag, payload):
+            if jax.process_index() == 0:
+                print("publishing", tag)
+            fleet_barrier(f"publish.{tag}")
+        """,
+    )
+    assert findings == []
+
+
+def test_dv701_suppressed_with_reason(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def publish(tag):
+            if jax.process_index() == 0:  # mtt: disable=DV701 -- single-rank debug tool, never run on a fleet
+                fleet_barrier(f"publish.{tag}")
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- DV702
+
+
+def test_dv702_branches_issue_different_schedules(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from jax import lax
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def step(x, rank):
+            if rank == 0:
+                fleet_barrier("sync")
+                lax.psum(x, "data")
+            else:
+                lax.psum(x, "data")
+                fleet_barrier("sync")
+        """,
+    )
+    assert "DV702" in _rules(findings)
+    assert "schedules differ" in [
+        f for f in findings if f.rule == "DV702"
+    ][0].message
+
+
+def test_dv702_clean_same_schedule_both_branches(tmp_path):
+    # Divergent control flow is fine when both sides issue the SAME
+    # schedule (e.g. different logging around the same collective).
+    findings = _lint(
+        tmp_path,
+        """
+        from jax import lax
+
+
+        def step(x, rank):
+            if rank == 0:
+                print("lead")
+                lax.psum(x, "data")
+            else:
+                lax.psum(x, "data")
+        """,
+    )
+    assert [f for f in findings if f.rule == "DV702"] == []
+
+
+# ------------------------------------------------------------------- DV703
+
+
+def test_dv703_rank_flows_into_collective_operand(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from jax import lax
+
+
+        def bad(x):
+            offset = jax.process_index() * 10
+            return lax.psum(x + offset, "data")
+        """,
+    )
+    assert "DV703" in _rules(findings)
+    assert "collective operand" in [
+        f for f in findings if f.rule == "DV703"
+    ][0].message
+
+
+def test_dv703_host_len_flows_into_traced_shape(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+
+        def bad():
+            n_local = len(jax.local_devices())
+            return jnp.zeros(n_local)
+        """,
+    )
+    assert "DV703" in _rules(findings)
+    assert "traced array shape" in [
+        f for f in findings if f.rule == "DV703"
+    ][0].message
+
+
+def test_dv703_clean_uniform_shape(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+
+        def ok(batch_size):
+            n = jax.device_count()
+            return jnp.zeros(batch_size // n)
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- DV704
+
+
+def test_dv704_wall_clock_on_publish_path(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import json
+        import time
+
+
+        def save_checkpoint(path, payload):
+            payload["ts"] = time.time()
+            path.write_text(json.dumps(payload))
+        """,
+    )
+    assert "DV704" in _rules(findings)
+    assert "wall clock" in [
+        f for f in findings if f.rule == "DV704"
+    ][0].message
+
+
+def test_dv704_unseeded_rng_on_resume_path(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import random
+
+
+        def restore_checkpoint(candidates):
+            return random.choice(candidates)
+        """,
+    )
+    assert "DV704" in _rules(findings)
+    assert "unseeded RNG" in [
+        f for f in findings if f.rule == "DV704"
+    ][0].message
+
+
+def test_dv704_unsorted_dir_iteration_transitively_reachable(tmp_path):
+    # The nondeterminism sits in a helper the entry point calls — the
+    # class-aware callgraph must carry reachability through it.
+    findings = _lint(
+        tmp_path,
+        """
+        def _scan(ckpt_dir):
+            out = []
+            for p in ckpt_dir.iterdir():
+                out.append(p)
+            return out
+
+
+        def restore_checkpoint(ckpt_dir):
+            return _scan(ckpt_dir)[-1]
+        """,
+    )
+    assert "DV704" in _rules(findings)
+    assert "iteration order" in [
+        f for f in findings if f.rule == "DV704"
+    ][0].message
+
+
+def test_dv704_clean_seeded_and_sorted(tmp_path):
+    # Seeded RNG and sorted() iteration are deterministic; the same ops
+    # OUTSIDE the checkpoint path never fire at all.
+    findings = _lint(
+        tmp_path,
+        """
+        import random
+
+
+        def save_checkpoint(ckpt_dir, seed):
+            rng = random.Random(seed)
+            order = sorted(ckpt_dir.iterdir())
+            for p in order:
+                pass
+            return rng.random()
+
+
+        def unrelated_tool(d):
+            for p in d.iterdir():
+                pass
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- DV705
+
+
+def test_dv705_unfenced_rank0_side_effect(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+
+
+        def promote(staging, final):
+            if jax.process_index() == 0:
+                staging.replace(final)
+        """,
+    )
+    assert _rules(findings) == {"DV705"}
+    assert "no named barrier" in findings[0].message
+
+
+def test_dv705_transitive_side_effect_through_helper(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import shutil
+
+
+        def _promote(staging, final):
+            shutil.move(staging, final)
+
+
+        def publish(staging, final):
+            if jax.process_index() == 0:
+                _promote(staging, final)
+        """,
+    )
+    assert _rules(findings) == {"DV705"}
+
+
+def test_dv705_clean_when_fenced(tmp_path):
+    # The repo's save_checkpoint/_run_recovery shape: rank-0 mutation +
+    # a named barrier later in the same function.
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def promote(staging, final, tag):
+            if jax.process_index() == 0:
+                staging.replace(final)
+            fleet_barrier(f"publish.{tag}")
+        """,
+    )
+    assert findings == []
+
+
+def test_dv705_regression_unfenced_recovery_shape(tmp_path):
+    # Regression pin for the _run_recovery fix: the PRE-fix shape (rank-0
+    # renames, peers poll, no barrier) must keep firing DV705 so the
+    # barrier can never be dropped silently.
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+
+
+        def _recover_staged(ckpt_dir, tag):
+            (ckpt_dir / f"{tag}.new").replace(ckpt_dir / tag)
+
+
+        def _run_recovery(ckpt_dir, tag):
+            if jax.process_index() == 0:
+                _recover_staged(ckpt_dir, tag)
+        """,
+    )
+    assert _rules(findings) == {"DV705"}
+
+
+# ------------------------------------------- interprocedural taint plumbing
+
+
+def test_return_taint_crosses_functions(tmp_path):
+    # process_identity()-style helper: the rank taint must survive the
+    # tuple-return / tuple-unpack round trip into the guard.
+    findings = _lint(
+        tmp_path,
+        """
+        import os
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def identity():
+            proc = int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+            nproc = int(os.environ.get("JAX_PROCESS_COUNT", "1"))
+            return proc, nproc
+
+
+        def run(tag):
+            proc, nproc = identity()
+            if proc == 0:
+                fleet_barrier(f"lead.{tag}")
+        """,
+    )
+    assert "DV701" in _rules(findings)
+
+
+def test_rank_param_name_is_a_source(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def run(rank):
+            if rank == 0:
+                fleet_barrier("lead")
+        """,
+    )
+    assert "DV701" in _rules(findings)
+
+
+# --------------------------------------------------- suppression surfacing
+
+
+def test_include_suppressed_marks_instead_of_dropping(tmp_path):
+    src = """
+        import jax
+        from masters_thesis_tpu.parallel.mesh import fleet_barrier
+
+
+        def publish(tag):
+            if jax.process_index() == 0:  # mtt: disable=DV701 -- intentional single-rank path
+                fleet_barrier(f"publish.{tag}")
+    """
+    assert _lint(tmp_path, src) == []
+    kept = _lint(tmp_path, src, include_suppressed=True)
+    assert len(kept) == 1
+    assert kept[0].rule == "DV701"
+    assert kept[0].suppressed is True
+    assert "[suppressed]" in kept[0].format()
+
+
+def test_cli_json_carries_suppression_state(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "masters_thesis_tpu.analysis",
+            "--spmd", "--json",
+        ],
+        cwd=_REPO_ROOT,
+        capture_output=True,
+        text=True,
+    )
+    # The repo lints clean modulo reasoned suppressions, so --json exits
+    # 0 while still listing every suppressed finding for CI's inventory.
+    assert out.returncode == 0, out.stdout + out.stderr
+    findings = json.loads(out.stdout)
+    assert all(set(f) >= {"rule", "message", "path", "line", "suppressed"}
+               for f in findings)
+    assert all(f["suppressed"] for f in findings)
+
+
+# ------------------------------------------------------- acceptance: repo
+
+
+def test_repo_lints_clean_under_spmd_pass():
+    findings = lint_spmd(
+        [
+            _PKG_ROOT / "train",
+            _PKG_ROOT / "parallel",
+            _PKG_ROOT / "resilience",
+            _PKG_ROOT / "telemetry",
+        ],
+        package_root=_PKG_ROOT,
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_run_recovery_is_barrier_fenced():
+    # The dogfooded DV705 fix: recovery must end at a named barrier so a
+    # non-zero rank can't read the pre-recovery tree.
+    src = (_PKG_ROOT / "train" / "checkpoint.py").read_text()
+    assert 'fleet_barrier(f"checkpoint.recover.{tag}")' in src
+
+
+# ------------------------------------------------------ hash chain (unit)
+
+
+def test_chain_is_deterministic_and_order_sensitive():
+    a, b, c = (CollectiveSchedule() for _ in range(3))
+    for s in (a, b):
+        s.record("pmean", name="grads", step=0)
+        s.record("barrier", name="epoch.0", step=0)
+    c.record("barrier", name="epoch.0", step=0)
+    c.record("pmean", name="grads", step=0)
+    assert a.snapshot()["chain"] == b.snapshot()["chain"]
+    assert a.snapshot()["chain"] != c.snapshot()["chain"]
+    assert a.snapshot()["n"] == 2
+
+
+def test_chain_tail_is_bounded():
+    s = CollectiveSchedule(keep=4)
+    for i in range(10):
+        s.record("barrier", name=f"b{i}", step=i)
+    snap = s.snapshot()
+    assert snap["n"] == 10
+    assert [e["step"] for e in snap["tail"]] == [6, 7, 8, 9]
+
+
+def test_audit_match_and_insufficient():
+    a, b = CollectiveSchedule(), CollectiveSchedule()
+    for s in (a, b):
+        s.record("barrier", name="x")
+    ok = audit_schedules({"p0": a.snapshot(), "p1": b.snapshot()})
+    assert ok["ok"] and ok["verdict"] == "match"
+    one = audit_schedules({"p0": a.snapshot(), "p1": None})
+    assert one["ok"] and one["verdict"] == "insufficient"
+
+
+def test_audit_names_divergent_rank_and_step():
+    lead, lag = CollectiveSchedule(), CollectiveSchedule()
+    for step in range(4):
+        lead.record("pmean", name="grads", step=step)
+        lead.record("barrier", name=f"epoch.{step}", step=step)
+        lag.record("pmean", name="grads", step=step)
+        if step != 2:  # the divergent rank skips step 2's barrier
+            lag.record("barrier", name=f"epoch.{step}", step=step)
+    audit = audit_schedules(
+        {"p0": lead.snapshot(), "p1": lag.snapshot()}
+    )
+    assert not audit["ok"]
+    assert audit["verdict"] == "diverged"
+    assert audit["divergent_rank"] == "p1"
+    assert audit["index"] == 5  # first fork: p0's step-2 barrier slot
+    assert "epoch.2" in audit["detail"]
+    assert set(audit["schedules"]) == {"p0", "p1"}
+
+
+def test_audit_lagging_is_not_divergence():
+    lead, lag = CollectiveSchedule(), CollectiveSchedule()
+    for step in range(4):
+        lead.record("barrier", name=f"epoch.{step}", step=step)
+        if step < 2:  # same prefix, then silence (wedged/killed rank)
+            lag.record("barrier", name=f"epoch.{step}", step=step)
+    audit = audit_schedules(
+        {"p0": lead.snapshot(), "p1": lag.snapshot()}
+    )
+    assert audit["ok"] and audit["verdict"] == "lagging"
+    assert audit["laggard"] == "p1"
+    assert "epoch.2" in audit["detail"]
+
+
+def test_read_rank_schedules_prefers_freshest_record(tmp_path):
+    s = CollectiveSchedule()
+    s.record("barrier", name="a")
+    stale = s.snapshot()
+    s.record("barrier", name="b")
+    fresh = s.snapshot()
+    p0 = tmp_path / "g0" / "p0"
+    p0.mkdir(parents=True)
+    (p0 / "heartbeat.json").write_text(
+        json.dumps({"collective_schedule": stale})
+    )
+    (p0 / "crashdump.json").write_text(
+        json.dumps({"collective_schedule": fresh})
+    )
+    snaps = read_rank_schedules(tmp_path / "g0")
+    assert snaps["p0"]["n"] == 2
+    assert snaps["p0"]["chain"] == fresh["chain"]
+
+
+def test_fleetsup_generation_audit_reads_rank_dirs(tmp_path):
+    # The supervisor-side audit consumes exactly what read_rank_schedules
+    # returns for a generation directory — fabricate a diverged g0.
+    lead, lag = CollectiveSchedule(), CollectiveSchedule()
+    for step in range(3):
+        lead.record("barrier", name=f"epoch.{step}", step=step)
+        if step != 1:
+            lag.record("barrier", name=f"epoch.{step}", step=step)
+    for rank, sched in (("p0", lead), ("p1", lag)):
+        d = tmp_path / "g0" / rank
+        d.mkdir(parents=True)
+        (d / "heartbeat.json").write_text(
+            json.dumps({"collective_schedule": sched.snapshot()})
+        )
+    audit = audit_schedules(read_rank_schedules(tmp_path / "g0"))
+    assert not audit["ok"]
+    assert audit["divergent_rank"] == "p1"
+    assert audit["step"] is not None
+
+
+# ------------------------------------- acceptance: 2-rank fleet scenario
+
+
+def _run_fleet(root: Path, scenario: str) -> None:
+    env = {**os.environ, "PYTHONPATH": str(_REPO_ROOT)}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), str(root), str(r), "2",
+             scenario],
+            cwd=_REPO_ROOT,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for r in (0, 1)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=60)
+        assert p.returncode == 0, err
+        assert out.strip().endswith("done"), out
+
+
+def _postmortem(root: Path) -> tuple[int, str]:
+    out = subprocess.run(
+        [sys.executable, "-m", "masters_thesis_tpu.telemetry",
+         "postmortem", str(root)],
+        cwd=_REPO_ROOT,
+        capture_output=True,
+        text=True,
+    )
+    return out.returncode, out.stdout + out.stderr
+
+
+def test_injected_divergence_caught_statically():
+    # Static half of the acceptance criterion: the worker's injected
+    # rank-divergent branch is a DV701 at the exact line.
+    findings = lint_spmd([_WORKER])
+    dv701 = [f for f in findings if f.rule == "DV701"]
+    assert dv701, "\n".join(f.format() for f in findings)
+    src_lines = _WORKER.read_text().splitlines()
+    flagged = src_lines[dv701[0].line - 1]
+    assert "scenario == \"divergent\"" in flagged
+
+
+@pytest.mark.slow
+def test_divergent_fleet_postmortem_exits_2_naming_rank_and_step(tmp_path):
+    _run_fleet(tmp_path, "divergent")
+    code, text = _postmortem(tmp_path)
+    assert code == 2, text
+    assert "DIVERGED" in text
+    assert "rank p1" in text          # the divergent rank, by name
+    assert "entry 5" in text          # the fork index
+    assert "barrier name=epoch.2" in text  # the skipped step's barrier
+    # Both schedule hash chains, named with their lengths.
+    assert "(8 entries)" in text and "(7 entries)" in text
+
+
+@pytest.mark.slow
+def test_healthy_fleet_chains_match_and_exit_0(tmp_path):
+    _run_fleet(tmp_path, "healthy")
+    code, text = _postmortem(tmp_path)
+    assert code == 0, text
+    assert "match" in text
+    assert "DIVERGED" not in text

@@ -31,6 +31,13 @@ JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
 echo "== concurrency + contract lint =="
 python -m masters_thesis_tpu.analysis --concurrency --contracts || fail=1
 
+# 2b'. Pass 4: SPMD divergence lint (DV701-DV705 — host-divergent
+#      control flow around collectives, divergent schedules/operands,
+#      checkpoint-path nondeterminism, unfenced rank-0 side effects)
+#      over the train/parallel/resilience/telemetry stack.
+echo "== spmd divergence lint =="
+python -m masters_thesis_tpu.analysis --spmd || fail=1
+
 # 2c. The event-schema lockfile must match what the code actually emits;
 #     regenerate with `python -m masters_thesis_tpu.analysis --emit-schema`
 #     after changing emitters.
