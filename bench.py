@@ -813,6 +813,16 @@ def _serve_sustained_bench() -> int:
     cache_ctx = tempfile.TemporaryDirectory()
     cache = ProgramCache(cache_ctx.name)
 
+    # Live telemetry plane over the ramp: the fleet's serve.request spans
+    # feed a manually-ticked SLO engine plus a /metrics exposition server
+    # the bench scrapes at every stage boundary — so the JSON line records
+    # WHERE on the QPS ladder each alert first fired (detail.alerts), and
+    # the alert knee can be cross-checked against the measured knee.
+    from masters_thesis_tpu.telemetry import TelemetryRun
+
+    tel_ctx = tempfile.TemporaryDirectory()
+    tel = TelemetryRun(Path(tel_ctx.name) / "serve-sustained")
+
     def factory_for(m):
         return lambda: PredictEngine(
             spec, params,
@@ -827,6 +837,7 @@ def _serve_sustained_bench() -> int:
         fleet = FleetServer(
             factories, max_wait_s=0.002,
             restart_policy=ReplicaRestartPolicy(backoff_s=0.01),
+            telemetry=tel,
         )
         t_boot = time.perf_counter()
         fleet.start()
@@ -856,6 +867,56 @@ def _serve_sustained_bench() -> int:
     windows = rng.standard_normal(
         (8, SERVE_STOCKS, SERVE_LOOKBACK, SERVE_FEATURES)
     ).astype(np.float32)
+
+    # SLO rules scaled to the ramp's 1.5s stages (the defaults' 60s/300s
+    # windows would never fill here): one tick per stage boundary, so a
+    # rule fires the first stage its windows breach. The engine is ticked
+    # from THIS thread only — single-writer, same contract as the
+    # monitor-thread mode the servers use.
+    import urllib.request
+
+    from masters_thesis_tpu.telemetry.exposition import attach_exposition
+    from masters_thesis_tpu.telemetry.slo import SLOEngine, SLORule
+
+    _fast = 2.0 * SUSTAINED_STAGE_S
+    _slow = 8.0 * SUSTAINED_STAGE_S
+    slo_rules = [
+        SLORule(
+            "p99-latency", "p99_latency", threshold=deadline_s,
+            fast_window_s=_fast, slow_window_s=_slow,
+        ),
+        SLORule(
+            "shed-rate", "shed_pct", threshold=SUSTAINED_SHED_PCT_MAX,
+            fast_window_s=_fast, slow_window_s=_slow,
+        ),
+        SLORule(
+            "error-budget-burn", "burn_rate", threshold=2.0,
+            fast_window_s=_fast, slow_window_s=_slow,
+        ),
+    ]
+    slo_engine = SLOEngine(tel.run_dir, rules=slo_rules, sink=tel.sink)
+    expo = attach_exposition(tel, port=0, slo=slo_engine)
+    alert_timeline: list[dict] = []
+    alert_first_fire: dict[str, float] = {}
+    metrics_scrapes = 0
+
+    def scrape_stage(qps: float) -> list[str]:
+        """Tick the SLO engine over the stage's spans, scrape /metrics
+        (the pull path a real Prometheus would take), note first fires."""
+        nonlocal metrics_scrapes
+        state = slo_engine.tick()
+        body = urllib.request.urlopen(
+            expo.url + "/metrics", timeout=10
+        ).read().decode()
+        if "mtt_slo_firing" in body:
+            metrics_scrapes += 1
+        firing = sorted(state.get("firing") or [])
+        for rule in firing:
+            alert_first_fire.setdefault(rule, round(qps, 2))
+        alert_timeline.append(
+            {"offered_qps": round(qps, 2), "firing": firing}
+        )
+        return firing
 
     def run_stage(qps: float) -> dict:
         gap = 1.0 / qps
@@ -898,6 +959,7 @@ def _serve_sustained_bench() -> int:
     qps = max(1.0, 0.25 * capacity_qps)
     for _ in range(SUSTAINED_MAX_STAGES):
         stage = run_stage(qps)
+        stage["alerts_firing"] = scrape_stage(qps)
         stage["sustainable"] = (
             stage["completed"] > 0
             and stage["shed_pct"] <= SUSTAINED_SHED_PCT_MAX
@@ -910,6 +972,20 @@ def _serve_sustained_bench() -> int:
         knee = stage
         qps *= SUSTAINED_RAMP
     stats = fleet.stop()
+    # Cooldown: with load off, the breach windows age out and two clean
+    # ticks (clear_ticks=2) resolve whatever fired at the knee — the
+    # fire->resolve round trip, observed through the same live plane.
+    resolved_rules: list[str] = []
+    if alert_first_fire:
+        deadline = time.monotonic() + 4.0 * SUSTAINED_STAGE_S
+        while time.monotonic() < deadline:
+            time.sleep(0.5 * SUSTAINED_STAGE_S)
+            state = slo_engine.tick()
+            if not state.get("firing"):
+                break
+        resolved_rules = sorted(
+            set(alert_first_fire) - set(slo_engine.state().get("firing") or [])
+        )
     util = {
         name: round(rep["utilization"], 4)
         for name, rep in stats["replicas"].items()
@@ -931,8 +1007,18 @@ def _serve_sustained_bench() -> int:
     late += int(stats2["late_deliveries"])
     cache_stats = cache.stats()
     cache_ctx.cleanup()
+    expo.close()
+    slo_engine.stop()
+    tel.close()
+    tel_ctx.cleanup()
 
     knee_qps = None if knee is None else knee["offered_qps"]
+    # The alert plane's view of the knee: the lowest offered QPS at which
+    # ANY rule first fired. A healthy plane agrees with the measured knee
+    # to within one ramp stage (x1.4).
+    alert_knee_qps = (
+        min(alert_first_fire.values()) if alert_first_fire else None
+    )
     result = {
         "metric": "serve_knee_qps",
         "value": knee_qps,
@@ -961,6 +1047,14 @@ def _serve_sustained_bench() -> int:
                 "warm_served_ok": warm_ok,
                 "program_cache": cache_stats,
             },
+            "alerts": {
+                "rules": [r.name for r in slo_rules],
+                "first_fire_qps": alert_first_fire,
+                "alert_knee_qps": alert_knee_qps,
+                "resolved_after_cooldown": resolved_rules,
+                "timeline": alert_timeline,
+                "metrics_scrapes": metrics_scrapes,
+            },
         },
     }
     try:
@@ -984,6 +1078,8 @@ def _serve_sustained_bench() -> int:
             p99_at_knee_ms=None if knee is None else knee["p99_ms"],
             shed_pct_at_knee=None if knee is None else knee["shed_pct"],
             replica_utilization=util,
+            alert_knee_qps=alert_knee_qps,
+            alert_first_fire=alert_first_fire,
         ))
         append_record(path, ledger_record(
             point="serve/restart_s",

@@ -93,6 +93,9 @@ POINTS = frozenset(
         # failure; the restart policy classifies the repeat)
         "cache.load",  # program-cache entry load (corrupt -> byte flipped
         # on disk, exercising the torn-entry refusal)
+        "slo.evaluate",  # SLO engine tick (kind: wedge -> the evaluator
+        # stops folding new events and its published /slo state goes
+        # stale, WITHOUT touching the serving path it observes)
     }
 )
 

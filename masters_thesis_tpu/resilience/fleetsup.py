@@ -167,6 +167,8 @@ class FleetSupervisor:
         env: dict | None = None,
         ckpt_dir: Path | str | None = None,
         coordinator_host: str = "127.0.0.1",
+        metrics_port: int | None = None,
+        slo_rules=None,
     ) -> None:
         self.cmd_template = [str(a) for a in cmd_template]
         self.run_dir = Path(run_dir)
@@ -187,6 +189,15 @@ class FleetSupervisor:
         self._trace = None
         self._run_span = None
         self._ranks: list[_Rank] = []
+        # Live telemetry plane (telemetry/exposition.py): /metrics + /slo
+        # for the whole fleet. The SLO engine tails the run dir tree —
+        # every rank's stream plus the supervisor's own — so heartbeat
+        # staleness on ANY rank fires mid-generation. None disables; 0
+        # binds an ephemeral port.
+        self.metrics_port = metrics_port
+        self._slo_rules = slo_rules
+        self._exposition = None
+        self._slo_engine = None
 
     # ------------------------------------------------------------ telemetry
 
@@ -553,6 +564,24 @@ class FleetSupervisor:
             cmd=shlex.join(self.cmd_template),
             trace_id=self.trace_id,
         )
+        if self.metrics_port is not None:
+            try:
+                from masters_thesis_tpu.telemetry.exposition import (
+                    start_telemetry_plane,
+                )
+                from masters_thesis_tpu.telemetry.slo import (
+                    default_train_rules,
+                )
+
+                self._exposition, self._slo_engine = start_telemetry_plane(
+                    self._telemetry(),
+                    self.metrics_port,
+                    rules=self._slo_rules or default_train_rules(),
+                    root=self.run_dir,
+                )
+            except Exception:
+                # Monitoring must never kill supervision.
+                self._exposition = self._slo_engine = None
         world = cfg.nprocs
         gen = 0
         relaunches_at_size = 0
@@ -656,6 +685,16 @@ class FleetSupervisor:
             resized=result.resized,
             trace_id=self.trace_id,
         )
+        if self._exposition is not None or self._slo_engine is not None:
+            try:
+                from masters_thesis_tpu.telemetry.exposition import (
+                    stop_telemetry_plane,
+                )
+
+                stop_telemetry_plane(self._exposition, self._slo_engine)
+            except Exception:
+                pass
+            self._exposition = self._slo_engine = None
         if self._tel is not None:
             try:
                 self._tel.close()

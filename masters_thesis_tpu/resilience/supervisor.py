@@ -317,6 +317,8 @@ class RunSupervisor:
         watch_dir: Path | str | None = None,
         ckpt_dir: Path | str | None = None,
         passthrough: bool = False,
+        metrics_port: int | None = None,
+        slo_rules=None,
     ) -> None:
         self.cmd = list(cmd)
         self.run_dir = Path(run_dir)
@@ -328,6 +330,15 @@ class RunSupervisor:
         self.passthrough = passthrough
         self._tel = None
         self._degraded = False
+        # Live telemetry plane (telemetry/exposition.py): /metrics + /slo
+        # for the whole supervised run. The SLO engine tails the CHILD's
+        # streams (watch_dir) so heartbeat-staleness / divergence alerts
+        # fire while an attempt is still running, not at the verdict.
+        # None disables; 0 binds an ephemeral port.
+        self.metrics_port = metrics_port
+        self._slo_rules = slo_rules
+        self._exposition = None
+        self._slo_engine = None
         # One stable trace id for the WHOLE supervised run: adopted from
         # the caller's env when present (a grid runner tracing the cell),
         # minted once otherwise — and propagated FORWARD to every attempt
@@ -701,6 +712,24 @@ class RunSupervisor:
             probe=cfg.probe,
             trace_id=self.trace_id,
         )
+        if self.metrics_port is not None:
+            try:
+                from masters_thesis_tpu.telemetry.exposition import (
+                    start_telemetry_plane,
+                )
+                from masters_thesis_tpu.telemetry.slo import (
+                    default_train_rules,
+                )
+
+                self._exposition, self._slo_engine = start_telemetry_plane(
+                    self._telemetry(),
+                    self.metrics_port,
+                    rules=self._slo_rules or default_train_rules(),
+                    root=self.watch_dir or self.run_dir,
+                )
+            except Exception:
+                # Monitoring must never kill supervision.
+                self._exposition = self._slo_engine = None
         t_start = time.monotonic()
         attempt = 0
         retries = rollbacks = 0
@@ -819,6 +848,16 @@ class RunSupervisor:
             trace_id=self.trace_id,
         )
         result.degraded = self._degraded
+        if self._exposition is not None or self._slo_engine is not None:
+            try:
+                from masters_thesis_tpu.telemetry.exposition import (
+                    stop_telemetry_plane,
+                )
+
+                stop_telemetry_plane(self._exposition, self._slo_engine)
+            except Exception:
+                pass
+            self._exposition = self._slo_engine = None
         if self._tel is not None:
             try:
                 self._tel.close()

@@ -163,11 +163,20 @@ class FleetServer:
         breaker_threshold: int = 3,
         restart_policy: ReplicaRestartPolicy | None = None,
         hang_timeout_s: float = 2.0,
+        metrics_port: int | None = None,
+        slo_rules=None,
     ):
         if not engine_factories:
             raise ValueError("fleet needs at least one engine factory")
         self.telemetry = telemetry
         self.health = health
+        # Live telemetry plane (telemetry/exposition.py): /metrics +
+        # /slo over the fleet's registry. None disables; 0 binds an
+        # ephemeral port. Reader-side only — never on the dispatch path.
+        self.metrics_port = metrics_port
+        self._slo_rules = slo_rules
+        self._exposition = None
+        self._slo_engine = None
         self.restart_policy = restart_policy or ReplicaRestartPolicy()
         self.hang_timeout_s = hang_timeout_s
         self.replicas: dict[str, Replica] = {
@@ -299,8 +308,23 @@ class FleetServer:
             target=self._monitor_loop, name="fleet-monitor", daemon=True
         )
         self._monitor.start()
+        if self.metrics_port is not None and self.telemetry is not None:
+            from masters_thesis_tpu.telemetry.exposition import (
+                start_telemetry_plane,
+            )
+
+            self._exposition, self._slo_engine = start_telemetry_plane(
+                self.telemetry, self.metrics_port, rules=self._slo_rules
+            )
 
     def stop(self) -> dict:
+        if self._exposition is not None or self._slo_engine is not None:
+            from masters_thesis_tpu.telemetry.exposition import (
+                stop_telemetry_plane,
+            )
+
+            stop_telemetry_plane(self._exposition, self._slo_engine)
+            self._exposition = self._slo_engine = None
         self.queue.close()
         with self._lock:
             for r in self.replicas.values():

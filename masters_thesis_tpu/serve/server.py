@@ -84,10 +84,20 @@ class PredictServer:
         telemetry=None,
         health=None,
         breaker_threshold: int = 3,
+        metrics_port: int | None = None,
+        slo_rules=None,
     ):
         self.engine = engine
         self.telemetry = telemetry
         self.health = health
+        # Live telemetry plane (telemetry/exposition.py): /metrics +
+        # /slo over this server's registry. None disables; 0 binds an
+        # ephemeral port. Reader-side only — started in start(), never
+        # touched by the dispatch hot path.
+        self.metrics_port = metrics_port
+        self._slo_rules = slo_rules
+        self._exposition = None
+        self._slo_engine = None
         self.breaker = CircuitBreaker(breaker_threshold)
         self.service_model = ServiceTimeModel()
         # The queue's micro-batch can never exceed the largest compiled
@@ -192,10 +202,25 @@ class PredictServer:
             target=self._worker, name="serve-dispatch", daemon=True
         )
         self._thread.start()
+        if self.metrics_port is not None and self.telemetry is not None:
+            from masters_thesis_tpu.telemetry.exposition import (
+                start_telemetry_plane,
+            )
+
+            self._exposition, self._slo_engine = start_telemetry_plane(
+                self.telemetry, self.metrics_port, rules=self._slo_rules
+            )
 
     def stop(self) -> dict:
         """Drain, stop the dispatch thread, emit ``serve_finished``;
         returns the summary stats dict the event carries."""
+        if self._exposition is not None or self._slo_engine is not None:
+            from masters_thesis_tpu.telemetry.exposition import (
+                stop_telemetry_plane,
+            )
+
+            stop_telemetry_plane(self._exposition, self._slo_engine)
+            self._exposition = self._slo_engine = None
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=30.0)

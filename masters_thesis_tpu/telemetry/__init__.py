@@ -30,10 +30,25 @@ a program SHOULD do; this package measures what runs actually DO:
   supervisor attempts / grid cells / fleet workers, open-span flushing
   through the flight recorder, and the Perfetto export + critical-path
   attribution behind the ``trace`` CLI;
+- :mod:`exposition` — stdlib HTTP exposition: ``/metrics`` (Prometheus
+  text format over the registry snapshot), ``/healthz``, ``/slo``; one
+  owned listener thread per process, attachable to the trainer, both
+  serve servers, and both supervisors;
+- :mod:`slo`      — declarative SLO rules (p99 vs deadline, shed%,
+  multi-window error-budget burn rate, heartbeat staleness, starvation,
+  recompile, divergence) evaluated incrementally over the live event
+  streams via the tail-cursor reader; debounced ``alert_fired`` /
+  ``alert_resolved`` events flow back into the stream;
+- :mod:`watch`    — live fleet console (``watch`` CLI): incremental
+  stream merging through aggregate's digest fold, per-rank/per-replica
+  status, QPS/p99/shed, generation, firing alerts;
+- :mod:`signals`  — the typed autoscaling feed (knee QPS vs offered
+  load, headroom, per-replica EWMA service times, active alerts);
 - :mod:`report` + ``__main__`` — ``python -m masters_thesis_tpu.telemetry
-  summarize|aggregate|postmortem|ledger <run>``: single-run reports, fleet
-  postmortems, and perf-ledger diffs; exit nonzero on contract violations
-  / dead processes / >15% utilization or throughput regressions.
+  summarize|aggregate|postmortem|ledger|watch <run>``: single-run
+  reports, fleet postmortems, perf-ledger diffs, and the live console;
+  exit nonzero on contract violations / dead processes / >15%
+  utilization or throughput regressions.
 
 Event schema and metric taxonomy: docs/telemetry.md.
 """
@@ -49,7 +64,16 @@ from masters_thesis_tpu.telemetry.costs import (
     roofline_regime,
     utilization,
 )
-from masters_thesis_tpu.telemetry.events import EventSink, read_events
+from masters_thesis_tpu.telemetry.events import (
+    EventSink,
+    read_events,
+    read_new_lines,
+)
+from masters_thesis_tpu.telemetry.exposition import (
+    ExpositionServer,
+    attach_exposition,
+    render_prometheus,
+)
 from masters_thesis_tpu.telemetry.ledger import (
     append_record,
     ledger_diff,
@@ -70,6 +94,20 @@ from masters_thesis_tpu.telemetry.run import (
     TelemetryRun,
     device_memory_snapshot,
 )
+from masters_thesis_tpu.telemetry.signals import (
+    AutoscaleSignals,
+    collect_signals,
+    knee_from_ledger,
+)
+from masters_thesis_tpu.telemetry.slo import (
+    SLOEngine,
+    SLORule,
+    burn_rate,
+    default_serve_rules,
+    default_train_rules,
+    window_stats,
+)
+from masters_thesis_tpu.telemetry.watch import FleetWatch, render_watch
 from masters_thesis_tpu.telemetry.trace import (
     PARENT_SPAN_ENV,
     TRACE_ENV,
@@ -90,27 +128,41 @@ __all__ = [
     "child_env",
     "current_trace_id",
     "new_trace_id",
+    "AutoscaleSignals",
     "CompileTracker",
     "CostModel",
     "Counter",
     "EpochRecorder",
     "EventSink",
+    "ExpositionServer",
+    "FleetWatch",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "ProfilerWindow",
+    "SLOEngine",
+    "SLORule",
     "TelemetryRun",
     "aggregate_path",
     "append_record",
+    "attach_exposition",
+    "burn_rate",
+    "collect_signals",
+    "default_serve_rules",
+    "default_train_rules",
     "device_memory_snapshot",
     "extract_cost",
+    "knee_from_ledger",
     "ledger_diff",
     "ledger_record",
     "postmortem_path",
     "profile_jit",
     "read_events",
     "read_ledger",
-    "roofline_regime",
+    "read_new_lines",
+    "render_prometheus",
+    "render_watch",
+    "window_stats",
     "utilization",
 ]

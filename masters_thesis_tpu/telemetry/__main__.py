@@ -30,6 +30,11 @@ Subcommands:
   negative durations, spans left open by a cleanly closed process).
   ``--selfcheck`` runs the hermetic synthetic-fleet fixture instead (the
   tools/check.sh gate).
+- ``watch <root>`` — live fleet console: tail every ``events.jsonl``
+  under a root while the fleet writes them and render per-rank status,
+  QPS/p99/shed, generation, and firing SLO alerts, refreshing in place.
+  ``--once`` prints a single snapshot (tests, cron); ``--selfcheck``
+  runs the hermetic 2-process fixture instead (the tools/check.sh gate).
 - ``selfcheck`` — hermetic smoke of the whole pipeline (registry ->
   events -> report) in a temp dir; the tools/check.sh telemetry gate.
 
@@ -307,6 +312,25 @@ def _trace(args) -> int:
     return report["exit_code"]
 
 
+def _watch(args) -> int:
+    from masters_thesis_tpu.telemetry import watch
+
+    if args.selfcheck:
+        return watch.selfcheck()
+    if args.root is None:
+        print("watch: a run root is required (or --selfcheck)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        w = watch.FleetWatch(args.root, grace_s=args.grace)
+        print(json.dumps(w.refresh(), indent=2, default=str))
+        return 0
+    return watch.run_watch(
+        args.root, once=args.once, interval_s=args.interval,
+        grace_s=args.grace,
+    )
+
+
 def _selfcheck(args) -> int:
     from masters_thesis_tpu.telemetry.report import summarize_path
     from masters_thesis_tpu.telemetry.run import TelemetryRun
@@ -439,6 +463,35 @@ def main(argv: list[str] | None = None) -> int:
         help="hermetic synthetic-fleet span fixture instead of a run",
     )
     p_trace.set_defaults(fn=_trace)
+    p_watch = sub.add_parser(
+        "watch",
+        help="live fleet console over running event streams",
+    )
+    p_watch.add_argument(
+        "root", nargs="?", default=None,
+        help="root directory holding per-process run dirs",
+    )
+    p_watch.add_argument(
+        "--once", action="store_true",
+        help="render one snapshot and exit (tests, cron)",
+    )
+    p_watch.add_argument(
+        "--json", action="store_true",
+        help="print one machine-readable snapshot and exit",
+    )
+    p_watch.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="refresh interval in seconds (default 2)",
+    )
+    p_watch.add_argument(
+        "--grace", type=float, default=30.0, metavar="S",
+        help="treat processes active within S seconds as still running",
+    )
+    p_watch.add_argument(
+        "--selfcheck", action="store_true",
+        help="hermetic 2-process watch fixture instead of a live root",
+    )
+    p_watch.set_defaults(fn=_watch)
     p_check = sub.add_parser(
         "selfcheck", help="hermetic registry->events->report smoke"
     )

@@ -81,7 +81,18 @@ def _read_json(path: Path) -> dict | None:
 def digest_stream(path: Path, root: Path) -> dict:
     """Fold one process's stream (+ sidecar heartbeat/crashdump) into the
     per-process digest the fleet report is built from."""
-    events = read_events(path)
+    return digest_events(read_events(path), path, root)
+
+
+def digest_events(events: list[dict], path: Path, root: Path) -> dict:
+    """The digest fold over already-loaded events.
+
+    Split out of :func:`digest_stream` so incremental consumers (the
+    ``watch`` console's tail-cursor accumulation, telemetry/watch.py)
+    share THIS reconstruction rather than re-reading every stream from
+    byte zero on each refresh; ``path`` still names the stream's
+    location because the heartbeat/crashdump sidecars live next to it.
+    """
     by_kind: dict[str, list[dict]] = {}
     proc = nproc = None
     host = pid = None

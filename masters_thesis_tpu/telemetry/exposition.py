@@ -1,0 +1,307 @@
+"""Stdlib-only HTTP exposition: ``/metrics``, ``/healthz``, ``/slo``.
+
+One tiny :class:`ExpositionServer` per process renders the run's live
+state for pull-based monitoring:
+
+- ``/metrics`` — the :class:`~.registry.MetricsRegistry` snapshot in
+  Prometheus text exposition format (counters and gauges as-is;
+  histograms as summaries with ``quantile`` labels plus ``_sum`` /
+  ``_count``), with the registry's host/pid identity as labels and the
+  SLO engine's firing alerts as ``mtt_slo_firing`` gauges so a plain
+  Prometheus scrape sees alert state without parsing JSON;
+- ``/healthz`` — liveness JSON (the process answering IS the signal),
+  with the firing-alert list for load balancers that want degradation;
+- ``/slo`` — the :class:`~.slo.SLOEngine`'s full published state.
+
+Threading contract (the CL501–CL505 shape): the listener thread is
+spawned in :meth:`start` — never in ``__init__`` — and joined with a
+bounded timeout in :meth:`close`. Request handlers hold NO locks of
+ours: they call providers that copy state under their own short
+internal locks (``registry.snapshot()``, ``engine.state()``) and do all
+rendering on the handler thread afterwards. Routes are frozen before
+``start()``, so the handler reads the routing table without
+synchronization.
+
+Deliberately dependency-free (``http.server``): the container bakes in
+no prometheus client, and the text format is lines of ASCII.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_NAME_OK = set(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:"
+)
+
+
+def sanitize_metric_name(name: str, prefix: str = "mtt_") -> str:
+    """Map a registry name (``serve/request_wall_s``) onto the Prometheus
+    grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*`` with a stable ``mtt_`` prefix."""
+    cleaned = "".join(c if c in _NAME_OK else "_" for c in name)
+    if cleaned and cleaned[0].isdigit():
+        cleaned = "_" + cleaned
+    return prefix + cleaned
+
+
+def escape_label_value(value) -> str:
+    """Label-value escaping per the text format: backslash, quote, LF."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and LF only (quotes are literal)."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _labels(tags: dict, extra: dict | None = None) -> str:
+    merged = dict(tags)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in sorted(merged.items())
+        if v is not None
+    )
+    return "{" + inner + "}"
+
+
+def _num(value) -> str:
+    if value is None:
+        return "NaN"
+    value = float(value)
+    if value != value:
+        return "NaN"
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    return repr(value)
+
+
+def render_prometheus(
+    snapshot: dict, slo_state: dict | None = None
+) -> str:
+    """The registry snapshot (``MetricsRegistry.snapshot()`` shape) as
+    Prometheus text exposition format, plus ``mtt_slo_firing`` gauges
+    from an optional SLO state dict."""
+    tags = snapshot.get("tags") or {}
+    lines: list[str] = []
+    for name, inst in sorted((snapshot.get("metrics") or {}).items()):
+        kind = inst.get("type")
+        pname = sanitize_metric_name(name)
+        help_line = f"# HELP {pname} {escape_help(name)}"
+        if kind == "counter":
+            lines += [
+                help_line,
+                f"# TYPE {pname} counter",
+                f"{pname}{_labels(tags)} {_num(inst.get('value'))}",
+            ]
+        elif kind == "gauge":
+            lines += [
+                help_line,
+                f"# TYPE {pname} gauge",
+                f"{pname}{_labels(tags)} {_num(inst.get('value'))}",
+            ]
+        elif kind == "histogram":
+            lines += [help_line, f"# TYPE {pname} summary"]
+            for q, key in (("0.5", "p50"), ("0.99", "p99")):
+                lines.append(
+                    f"{pname}{_labels(tags, {'quantile': q})} "
+                    f"{_num(inst.get(key))}"
+                )
+            lines.append(
+                f"{pname}_sum{_labels(tags)} {_num(inst.get('sum'))}"
+            )
+            lines.append(
+                f"{pname}_count{_labels(tags)} "
+                f"{_num(inst.get('count') or 0)}"
+            )
+    if slo_state:
+        lines += [
+            "# HELP mtt_slo_firing 1 while the named SLO rule is firing",
+            "# TYPE mtt_slo_firing gauge",
+        ]
+        for rule, row in sorted((slo_state.get("rules") or {}).items()):
+            lines.append(
+                f"mtt_slo_firing{_labels(tags, {'rule': rule})} "
+                f"{1 if row.get('firing') else 0}"
+            )
+            if row.get("value") is not None:
+                lines.append(
+                    f"mtt_slo_value{_labels(tags, {'rule': rule})} "
+                    f"{_num(row.get('value'))}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class ExpositionServer:
+    """Owns one listener thread serving /metrics, /healthz, /slo."""
+
+    def __init__(
+        self,
+        registry=None,
+        slo=None,
+        bind_host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._registry = registry
+        self._slo = slo
+        self._bind_host = bind_host
+        self._requested_port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+
+    # ---------------------------------------------------------- handlers
+    # Called on http.server worker threads; they must copy state through
+    # the providers' own internal locks and render lock-free here.
+
+    def _get(self, path: str) -> tuple[int, str, str]:
+        path = path.split("?", 1)[0]
+        if path == "/metrics":
+            snap = (
+                self._registry.snapshot()
+                if self._registry is not None
+                else {"tags": {}, "metrics": {}}
+            )
+            state = self._slo.state() if self._slo is not None else None
+            return 200, "text/plain; version=0.0.4", render_prometheus(
+                snap, state
+            )
+        if path == "/healthz":
+            state = self._slo.state() if self._slo is not None else {}
+            body = json.dumps(
+                {
+                    "ok": True,
+                    "ts": time.time(),
+                    "firing": state.get("firing") or [],
+                }
+            )
+            return 200, "application/json", body
+        if path == "/slo":
+            state = self._slo.state() if self._slo is not None else {}
+            return 200, "application/json", json.dumps(state, default=str)
+        return 404, "text/plain", f"no route {path!r}\n"
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "ExpositionServer":
+        if self._httpd is not None:
+            return self
+        owner = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 -- http.server API
+                try:
+                    status, ctype, body = owner._get(self.path)
+                except Exception as exc:  # noqa: BLE001 -- a provider
+                    # error must answer 500, not kill the worker thread
+                    status, ctype, body = 500, "text/plain", f"{exc}\n"
+                payload = body.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self._bind_host, self._requested_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="exposition-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str | None:
+        if self.port is None:
+            return None
+        return f"http://{self._bind_host}:{self.port}"
+
+    def close(self) -> None:
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ExpositionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_telemetry_plane(
+    telemetry,
+    metrics_port: int | None,
+    rules=None,
+    slo_interval_s: float = 2.0,
+    root=None,
+):
+    """The one-call attach point components share: an SLO engine tailing
+    the run dir's streams plus an exposition server over the run's
+    registry. Returns ``(server, engine)`` — both ``None`` when the
+    component has no telemetry or no port was requested (``port=0``
+    binds an ephemeral port; ``None`` disables the plane). ``root``
+    points the SLO engine at a different stream tree than the run dir —
+    supervisors watch their CHILDREN's streams while exposing their own
+    registry."""
+    if telemetry is None or metrics_port is None:
+        return None, None
+    from masters_thesis_tpu.telemetry.slo import SLOEngine
+
+    engine = SLOEngine(
+        root or telemetry.run_dir, rules=rules, sink=telemetry.sink
+    )
+    engine.start(interval_s=slo_interval_s)
+    server = attach_exposition(telemetry, port=metrics_port, slo=engine)
+    return server, engine
+
+
+def stop_telemetry_plane(server, engine) -> None:
+    """Tear down what :func:`start_telemetry_plane` built (idempotent)."""
+    if server is not None:
+        server.close()
+    if engine is not None:
+        engine.stop()
+
+
+def attach_exposition(
+    telemetry, port: int = 0, bind_host: str = "127.0.0.1", slo=None
+) -> ExpositionServer:
+    """Start an exposition server over a :class:`~.run.TelemetryRun`'s
+    registry (plus an optional SLO engine) and record the bound URL in
+    the event stream so operators and the watch console can find it."""
+    server = ExpositionServer(
+        registry=telemetry.registry, slo=slo, bind_host=bind_host,
+        port=port,
+    ).start()
+    telemetry.event(
+        "exposition_started",
+        url=server.url,
+        port=server.port,
+        bind_host=bind_host,
+    )
+    return server

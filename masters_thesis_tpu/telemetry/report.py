@@ -199,9 +199,60 @@ def summarize_events(events: list[dict]) -> dict:
         "diverged": finished.get("diverged"),
         "profile_windows": profile_windows,
         "best_val": finished.get("best_val"),
+        "alerts": (
+            alert_state(events)
+            if (by_kind.get("alert_fired") or by_kind.get("alert_resolved"))
+            else None
+        ),
     }
     report["violations"] = contract_violations(report)
     return report
+
+
+def alert_state(events: list[dict]) -> dict:
+    """Fold ``alert_fired``/``alert_resolved`` (stream-ordered) into the
+    per-rule alert state. Shared by the live watch console and the
+    post-hoc ``alerts`` report section, so what the console showed while
+    the run was alive is — by construction — what summarize confirms
+    after it ends."""
+    rules: dict[str, dict] = {}
+    fired = resolved = 0
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in ("alert_fired", "alert_resolved"):
+            continue
+        name = ev.get("rule") or "?"
+        row = rules.setdefault(
+            name,
+            {
+                "rule": name,
+                "slo_kind": ev.get("slo_kind"),
+                "firing": False,
+                "since_ts": None,
+                "fired": 0,
+                "resolved": 0,
+                "last_value": None,
+                "threshold": ev.get("threshold"),
+            },
+        )
+        row["last_value"] = ev.get("value")
+        if kind == "alert_fired":
+            fired += 1
+            row["fired"] += 1
+            row["firing"] = True
+            row["since_ts"] = ev.get("ts")
+        else:
+            resolved += 1
+            row["resolved"] += 1
+            row["firing"] = False
+    return {
+        "rules": rules,
+        "active": sorted(
+            name for name, row in rules.items() if row["firing"]
+        ),
+        "fired": fired,
+        "resolved": resolved,
+    }
 
 
 def _restart_stats(events: list[dict], by_kind: dict) -> dict:
@@ -682,6 +733,25 @@ def render_text(report: dict) -> str:
                     if row.get("rollbacks")
                     else ""
                 ),
+            )
+    alerts = report.get("alerts")
+    if alerts:
+        active = alerts.get("active") or []
+        line = (
+            f"slo alerts     : {alerts.get('fired', 0)} fired, "
+            f"{alerts.get('resolved', 0)} resolved"
+        )
+        if active:
+            line += " | STILL FIRING: " + ", ".join(active)
+        lines.insert(len(lines) - 1, line)
+        for name, row in sorted((alerts.get("rules") or {}).items()):
+            lines.insert(
+                len(lines) - 1,
+                f"  - {name} ({row.get('slo_kind')}): "
+                f"{'FIRING' if row.get('firing') else 'resolved'}, "
+                f"last value {_fmt(row.get('last_value'), '.4g')} "
+                f"vs threshold {_fmt(row.get('threshold'), '.4g')} "
+                f"({row.get('fired', 0)} fire(s))",
             )
     gs = report.get("grad_sync") or {}
     if gs.get("collectives_per_step") is not None:

@@ -160,6 +160,8 @@ class Trainer:
         hang_timeout_s: float | None = None,
         checkpoint_every_n_epochs: int | None = None,
         cost_profile: bool | None = None,
+        metrics_port: int | None = None,
+        slo_rules=None,
     ):
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
@@ -232,6 +234,15 @@ class Trainer:
         # heartbeats and signal dumps but no hang detection (the default —
         # a legitimate giant compile must not be declared a hang).
         self.hang_timeout_s = hang_timeout_s
+        # Live telemetry plane (telemetry/exposition.py): /metrics + /slo
+        # over this run's registry while fit() is live. None disables; 0
+        # binds an ephemeral port. Reader-side only — the SLO engine tails
+        # events.jsonl; nothing runs on the step path (TL105/TA202
+        # unchanged).
+        self.metrics_port = metrics_port
+        self._slo_rules = slo_rules
+        self._exposition = None
+        self._slo_engine = None
 
     def _resolve_dtype(self, spec, dm):
         """Concrete compute dtype for this (model, window) shape.
@@ -562,6 +573,19 @@ class Trainer:
                 tel, steps_per_epoch, on_epoch=_mirror_epoch,
                 span_parent=fit_span,
             )
+            if self.metrics_port is not None:
+                from masters_thesis_tpu.telemetry.exposition import (
+                    start_telemetry_plane,
+                )
+                from masters_thesis_tpu.telemetry.slo import (
+                    default_train_rules,
+                )
+
+                self._exposition, self._slo_engine = start_telemetry_plane(
+                    tel,
+                    self.metrics_port,
+                    rules=self._slo_rules or default_train_rules(),
+                )
 
         # ---- static cost model of the hot program (telemetry/costs.py) ----
         # AOT lower+compile the exact program the loop runs and pull the
@@ -924,6 +948,14 @@ class Trainer:
                     {"perf/steps_per_sec": steps_per_sec},
                     self.max_epochs - 1,
                 )
+
+        if self._exposition is not None or self._slo_engine is not None:
+            from masters_thesis_tpu.telemetry.exposition import (
+                stop_telemetry_plane,
+            )
+
+            stop_telemetry_plane(self._exposition, self._slo_engine)
+            self._exposition = self._slo_engine = None
 
         return TrainResult(
             params=params,
