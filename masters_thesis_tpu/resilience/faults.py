@@ -27,6 +27,10 @@ Kinds:
   the just-published tree (seeded, deterministic).
 - ``nan``     — returned to the caller: the trainer poisons the host-side
   loss readback with NaN, triggering the divergence halt.
+- ``shift``   — returned to the caller: a seeded scale/offset regime
+  shift applied to window features (``x*scale + offset`` with both drawn
+  from :func:`shift_params`) at ``serve.admit`` / ``trainer.epoch_start``
+  — the deterministic trigger for the model-quality drift detectors.
 
 Match semantics: a spec fires when its ``point`` matches, the current
 supervisor attempt (``MTT_ATTEMPT``, default 1) equals ``attempt``
@@ -40,6 +44,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import signal
 import sys
 import time
@@ -51,7 +56,7 @@ FAULT_PLAN_ENV = "MTT_FAULT_PLAN"
 ATTEMPT_ENV = "MTT_ATTEMPT"
 
 KINDS = frozenset(
-    {"preempt", "kill", "hang", "raise", "wedge", "corrupt", "nan"}
+    {"preempt", "kill", "hang", "raise", "wedge", "corrupt", "nan", "shift"}
 )
 #: Kinds fire() executes itself (the process never returns normally).
 PROCESS_KINDS = frozenset({"preempt", "kill", "hang", "raise"})
@@ -63,6 +68,7 @@ DATA_KINDS = KINDS - PROCESS_KINDS
 POINTS = frozenset(
     {
         "trainer.epoch_start",  # top of the epoch loop, before dispatch
+        # (kind: shift -> regime shift on this epoch's window features)
         "trainer.epoch_dispatched",  # after dispatch, before readback/save
         "trainer.loss",  # host-side metric readback (kind: nan)
         "stacked.replica_loss",  # per-replica readback in the stacked
@@ -82,7 +88,8 @@ POINTS = frozenset(
         # as stale without needing a real hang; match on {"rank": r})
         "probe.attempt",  # backend probe attempt (kind: wedge)
         "worker.epoch",  # jax-free selfcheck worker epochs
-        "serve.admit",  # request admission (kind: wedge -> forced shed)
+        "serve.admit",  # request admission (kind: wedge -> forced shed;
+        # shift -> seeded scale/offset regime shift on the window x)
         "serve.dispatch",  # micro-batch dispatch (wedge -> device error)
         "serve.pre_swap",  # hot-swap candidate staged (kind: corrupt)
         "serve.replica_dispatch",  # fleet replica dispatch: wedge -> device
@@ -262,3 +269,14 @@ def corruption_seed(extra: int = 0) -> int:
     """Deterministic seed for data-kind corruption at a call site."""
     plan = active_plan()
     return (plan.seed if plan is not None else 0) * 1_000_003 + extra
+
+
+def shift_params(extra: int = 0) -> tuple[float, float]:
+    """Seeded ``(scale, offset)`` for the ``shift`` data-fault kind.
+
+    Deterministic in the plan seed (plus a call-site ``extra``), large
+    enough that a drift detector with industry-standard thresholds must
+    notice: scale in [1.25, 1.75), offset in [0.25, 0.75).
+    """
+    rng = random.Random(corruption_seed(extra))
+    return 1.0 + rng.uniform(0.25, 0.75), rng.uniform(0.25, 0.75)

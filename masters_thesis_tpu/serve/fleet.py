@@ -165,10 +165,16 @@ class FleetServer:
         hang_timeout_s: float = 2.0,
         metrics_port: int | None = None,
         slo_rules=None,
+        quality_monitor=None,
     ):
         if not engine_factories:
             raise ValueError("fleet needs at least one engine factory")
         self.telemetry = telemetry
+        # Model-quality plane (telemetry/quality.py): one fleet-wide
+        # 1-in-K sampler over *delivered* responses (its own lock makes
+        # the per-replica dispatcher threads safe), fed strictly after
+        # _resolve — never on the device path.
+        self.quality = quality_monitor
         self.health = health
         # Live telemetry plane (telemetry/exposition.py): /metrics +
         # /slo over the fleet's registry. None disables; 0 binds an
@@ -629,6 +635,7 @@ class FleetServer:
             np.isfinite(alpha).all() and np.isfinite(beta).all()
         )
         now = time.monotonic()
+        delivered: list[int] = []
         for i, p in enumerate(live):
             if not finite:
                 with self._lock:
@@ -655,10 +662,16 @@ class FleetServer:
                 self._resolve(
                     replica, p, STATUS_OK, outputs=(alpha[i], beta[i])
                 )
+                delivered.append(i)
                 if time.monotonic() > p.request.deadline_ts:
                     with self._lock:
                         self.late_deliveries += 1
                     self._count("late_deliveries")
+        if self.quality is not None:
+            # Strictly post-delivery, host-side numpy only (TL105/TA202
+            # and the serve preflight stay green by construction).
+            for i in delivered:
+                self.quality.sample(live[i].request.x, alpha[i], beta[i])
 
     # -------------------------------------------------------------- degrade
 

@@ -17,7 +17,9 @@ any backend:
 
 Fault point ``serve.admit`` (kind ``wedge``) forces a shed at submit time,
 so the chaos suite can drive deterministic overload decisions without
-having to race the real clock.
+having to race the real clock; kind ``shift`` applies a seeded
+scale/offset regime shift to the admitted window's features instead — the
+deterministic trigger for the model-quality drift detectors.
 
 Jax-free by contract: ``python -m masters_thesis_tpu.serve selfcheck``
 drives this module (and the server loop) with a fake engine on operator
@@ -201,8 +203,17 @@ class MicroBatchQueue:
             closed = self._closed
         if closed:
             return self._shed(pending, "server shutting down")
-        if faults.fire("serve.admit", rid=request.rid, depth=depth) == "wedge":
+        fired = faults.fire("serve.admit", rid=request.rid, depth=depth)
+        if fired == "wedge":
             return self._shed(pending, "injected admission shed (fault)")
+        if fired == "shift":
+            # Seeded scale/offset regime shift on the window features —
+            # the request is still served, but its data now comes from a
+            # shifted regime (the quality plane's deterministic trigger).
+            scale, offset = faults.shift_params()
+            request.x = (request.x * scale + offset).astype(
+                request.x.dtype, copy=False
+            )
         if depth >= self.max_depth:
             return self._shed(pending, f"queue full (depth {depth})")
         if self.feasibility is not None:

@@ -86,9 +86,14 @@ class PredictServer:
         breaker_threshold: int = 3,
         metrics_port: int | None = None,
         slo_rules=None,
+        quality_monitor=None,
     ):
         self.engine = engine
         self.telemetry = telemetry
+        # Model-quality plane (telemetry/quality.py): 1-in-K sampler over
+        # *delivered* responses, fed strictly after _resolve with the
+        # host-side arrays already in hand — never on the device path.
+        self.quality = quality_monitor
         self.health = health
         # Live telemetry plane (telemetry/exposition.py): /metrics +
         # /slo over this server's registry. None disables; 0 binds an
@@ -408,6 +413,7 @@ class PredictServer:
             np.isfinite(alpha).all() and np.isfinite(beta).all()
         )
         now = time.monotonic()
+        delivered: list[int] = []
         for i, p in enumerate(live):
             if not finite:
                 self._bump("errors")
@@ -429,11 +435,18 @@ class PredictServer:
                 self._resolve(
                     p, STATUS_OK, outputs=(alpha[i], beta[i])
                 )
+                delivered.append(i)
                 if time.monotonic() > p.request.deadline_ts:
                     # The delivery itself slid past the deadline — this
                     # must never happen (the check above runs against the
                     # same clock); count it so the bench can fail loudly.
                     self._bump("late_deliveries")
+        if self.quality is not None:
+            # Strictly post-delivery: every sampled response has already
+            # been resolved to its caller, and alpha/beta/x are host
+            # numpy — zero new fences or transfers on the hot path.
+            for i in delivered:
+                self.quality.sample(live[i].request.x, alpha[i], beta[i])
 
     # ----------------------------------------------------------- degrade
 

@@ -25,6 +25,16 @@ Only after all three pass does :meth:`CheckpointSwapper.try_swap` flip the
 engine's param pointer — one atomic reference swap under the engine lock;
 in-flight batches finish on the old tree, the next batch sees the new one.
 
+A fourth, *model-quality* gate rides on top when the candidate ships a
+``quality.json`` fingerprint (telemetry/quality.py): the candidate's
+golden-batch outputs are scored against its own shipped sketches and
+shadow-OLS budget (catching a fine-tune that silently diverged between
+fingerprinting and deploy), and — when a live :class:`QualityMonitor` is
+attached — against the live serving sketch. Rejections carry a named
+``quality_*`` reason plus the numeric scores. A checkpoint without a
+fingerprint and no live sketch passes untouched: the quality gate is
+additive, never a new way for a healthy legacy checkpoint to fail.
+
 Fault point ``serve.pre_swap`` (kind ``corrupt``) corrupts the candidate
 tree before verification — the chaos suite's torn-checkpoint drill.
 """
@@ -38,6 +48,7 @@ from typing import Any
 import numpy as np
 
 from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.telemetry import quality as quality_lib
 
 #: Default canary bound on |output|: the estimator's alpha/beta are
 #: standardized-return-scale quantities; anything this large is a blown-up
@@ -49,7 +60,7 @@ DEFAULT_MAX_ABS = 1e3
 class SwapVerdict:
     ok: bool
     reason: str  # "committed" | "verify_failed" | "restore_failed" |
-    #              "shape_mismatch" | "canary_<check>"
+    #              "shape_mismatch" | "canary_<check>" | "quality_<check>"
     detail: str = ""
     checks: dict = field(default_factory=dict)
 
@@ -123,6 +134,7 @@ class CheckpointSwapper:
         telemetry=None,
         max_abs: float = DEFAULT_MAX_ABS,
         max_drift: float | None = None,
+        quality_monitor=None,
     ):
         self.engine = engine
         self.golden_x = (
@@ -131,6 +143,11 @@ class CheckpointSwapper:
         self.telemetry = telemetry
         self.max_abs = max_abs
         self.max_drift = max_drift
+        #: Optional live QualityMonitor (telemetry/quality.py). When set,
+        #: the quality gate can score candidates against the live serving
+        #: sketch, and a committed swap re-baselines the monitor's
+        #: reference to the new checkpoint's shipped fingerprint.
+        self.quality = quality_monitor
         self.committed = 0
         self.rejected = 0
 
@@ -202,7 +219,58 @@ class CheckpointSwapper:
         )
         if not verdict.ok:
             return self._reject(tag, verdict)
+        # Model-quality gate: score the candidate against its own shipped
+        # fingerprint (regenerating the seeded golden windows it was
+        # fingerprinted on) and/or the live serving sketch. Skipped
+        # gracefully when neither exists — legacy checkpoints still swap.
+        fp = quality_lib.read_fingerprint(path)
+        try:
+            gold = (fp or {}).get("golden")
+            live = (
+                self.quality.live_summaries()
+                if self.quality is not None
+                else None
+            )
+            q_x = q_out = None
+            if gold is not None and tuple(gold["shape"][1:]) == tuple(
+                self.engine.window_shape
+            ):
+                q_x = quality_lib.golden_windows(
+                    *gold["shape"], seed=gold.get("seed", 0)
+                )
+                q_out = self._predict_chunked(q_x, candidate)
+            elif live:
+                # No usable fingerprint: fall back to the swapper's own
+                # golden batch so the live-sketch check still has outputs
+                # to score.
+                q_x, q_out = self.golden_x, candidate_out
+            if q_out is not None:
+                ok, reason, detail, qchecks = quality_lib.quality_gate(
+                    fp, q_x, q_out[0], q_out[1], live=live
+                )
+                verdict.checks.update(qchecks)
+                if not ok:
+                    return self._reject(
+                        tag,
+                        SwapVerdict(False, reason, detail, verdict.checks),
+                    )
+        except Exception as exc:  # noqa: BLE001 — a malformed fingerprint
+            # must reject the candidate, never take the replica down.
+            return self._reject(
+                tag,
+                SwapVerdict(
+                    False, "quality_error",
+                    f"quality gate could not score the candidate: "
+                    f"{type(exc).__name__}: {exc}",
+                    verdict.checks,
+                ),
+            )
         self.engine.set_params(candidate)
+        if self.quality is not None and fp is not None:
+            # The new checkpoint's fingerprint is now the drift baseline:
+            # an intentional retrain must not alarm against the OLD model's
+            # prediction sketch.
+            self.quality.set_reference(fp)
         self.committed += 1
         self._event(
             "swap_committed",
@@ -211,6 +279,23 @@ class CheckpointSwapper:
             checks=verdict.checks,
         )
         return verdict
+
+    def _predict_chunked(self, x: np.ndarray, params: Any) -> tuple:
+        """Predict a golden batch that may exceed the engine's largest
+        compiled bucket — fingerprints ship 32-window goldens while a
+        replica may only compile small buckets. Chunks of ``max_bucket``
+        windows each, concatenated host-side."""
+        cap = getattr(self.engine, "max_bucket", None)
+        if not cap or len(x) <= cap:
+            return self.engine.predict(x, params=params)
+        outs = [
+            self.engine.predict(x[i : i + cap], params=params)
+            for i in range(0, len(x), cap)
+        ]
+        return (
+            np.concatenate([np.asarray(o[0]) for o in outs]),
+            np.concatenate([np.asarray(o[1]) for o in outs]),
+        )
 
     def _host_serving_params(self) -> Any:
         import jax

@@ -35,6 +35,14 @@ Subcommands:
   QPS/p99/shed, generation, and firing SLO alerts, refreshing in place.
   ``--once`` prints a single snapshot (tests, cron); ``--selfcheck``
   runs the hermetic 2-process fixture instead (the tools/check.sh gate).
+- ``quality <root>`` — model-quality report over a run's event stream:
+  drift-sample folding (input/prediction PSI+KS, shadow-OLS
+  disagreement), breach counts, quality-rejected swaps, and the
+  detector-wiring contract (sustained shadow breach with an SLO engine
+  attached must have fired a ``shadow_disagreement`` alert). Exit codes:
+  0 = ok, 1 = could not load, 2 = a detector is breached or the wiring
+  contract is violated. ``--selfcheck`` runs the hermetic sketch-math +
+  detector + gate fixture instead (the tools/check.sh gate).
 - ``selfcheck`` — hermetic smoke of the whole pipeline (registry ->
   events -> report) in a temp dir; the tools/check.sh telemetry gate.
 
@@ -331,6 +339,38 @@ def _watch(args) -> int:
     )
 
 
+def _quality(args) -> int:
+    from masters_thesis_tpu.telemetry import quality as quality_lib
+
+    if args.selfcheck:
+        return 0 if quality_lib.selfcheck() else 1
+    if args.root is None:
+        print("quality: a run root is required (or --selfcheck)",
+              file=sys.stderr)
+        return 1
+    from masters_thesis_tpu.telemetry.events import read_events
+    from masters_thesis_tpu.telemetry.report import resolve_events_path
+
+    try:
+        events = read_events(resolve_events_path(args.root))
+    except FileNotFoundError as exc:
+        print(f"quality: {exc}", file=sys.stderr)
+        return 1
+    report = quality_lib.quality_report(events)
+    violations = quality_lib.quality_violations(events, report)
+    if args.json:
+        print(json.dumps(
+            {"quality": report, "violations": violations},
+            indent=2, default=str,
+        ))
+    else:
+        print(quality_lib.render_quality(report))
+        for v in violations:
+            print(f"CONTRACT VIOLATION: {v}")
+    breached = any((report.get("breaches") or {}).values())
+    return 2 if (violations or breached) else 0
+
+
 def _selfcheck(args) -> int:
     from masters_thesis_tpu.telemetry.report import summarize_path
     from masters_thesis_tpu.telemetry.run import TelemetryRun
@@ -492,6 +532,23 @@ def main(argv: list[str] | None = None) -> int:
         help="hermetic 2-process watch fixture instead of a live root",
     )
     p_watch.set_defaults(fn=_watch)
+    p_q = sub.add_parser(
+        "quality",
+        help="model-quality report (drift, shadow-OLS, gated swaps); "
+             "exit 2 on breach or wiring violation",
+    )
+    p_q.add_argument(
+        "root", nargs="?", default=None,
+        help="run directory (or events.jsonl file) to score",
+    )
+    p_q.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p_q.add_argument(
+        "--selfcheck", action="store_true",
+        help="hermetic sketch-math/detector/gate fixture instead of a run",
+    )
+    p_q.set_defaults(fn=_quality)
     p_check = sub.add_parser(
         "selfcheck", help="hermetic registry->events->report smoke"
     )

@@ -66,6 +66,11 @@ class Histogram:
     every other kept value and the keep-stride doubles — bounded memory,
     no randomness, and the kept points stay spread over the whole run
     rather than clustered at the start.
+
+    Thread-safe: fleet replicas observe the shared ``serve/latency_s``
+    histogram from concurrent dispatch threads, so the summary state and
+    the kept sample mutate under a lock (uncontended host-side acquire;
+    nothing here runs on the device hot path).
     """
 
     def __init__(self, name: str, max_samples: int = 2048):
@@ -77,37 +82,49 @@ class Histogram:
         self._samples: list[float] = []
         self._max_samples = max_samples
         self._stride = 1
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         value = float(value)
-        self.count += 1
-        self.sum += value
-        self.min = value if self.min is None else min(self.min, value)
-        self.max = value if self.max is None else max(self.max, value)
-        if (self.count - 1) % self._stride == 0:
-            self._samples.append(value)
-            if len(self._samples) >= self._max_samples:
-                self._samples = self._samples[::2]
-                self._stride *= 2
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            if (self.count - 1) % self._stride == 0:
+                self._samples.append(value)
+                if len(self._samples) >= self._max_samples:
+                    self._samples = self._samples[::2]
+                    self._stride *= 2
 
-    def quantile(self, q: float) -> float | None:
-        """Nearest-rank quantile over the kept sample (live approximation)."""
-        if not self._samples:
+    @staticmethod
+    def _rank(ordered: list[float], q: float) -> float | None:
+        if not ordered:
             return None
-        ordered = sorted(self._samples)
         idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
         return ordered[idx]
 
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile over the kept sample (live approximation)."""
+        with self._lock:
+            samples = list(self._samples)
+        return self._rank(sorted(samples), q)
+
     def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
+            samples = list(self._samples)
+        ordered = sorted(samples)
         return {
             "type": "histogram",
-            "count": self.count,
-            "sum": self.sum,
-            "min": self.min,
-            "max": self.max,
-            "mean": (self.sum / self.count) if self.count else None,
-            "p50": self.quantile(0.50),
-            "p99": self.quantile(0.99),
+            "count": count,
+            "sum": total,
+            "min": lo,
+            "max": hi,
+            "mean": (total / count) if count else None,
+            "p50": self._rank(ordered, 0.50),
+            "p99": self._rank(ordered, 0.99),
         }
 
 

@@ -1,6 +1,6 @@
 """Run reports: fold a JSONL event stream into the numbers that matter.
 
-Stdlib-only by contract — this module is what ``python -m
+Jax-free by contract (stdlib + numpy) — this module is what ``python -m
 masters_thesis_tpu.telemetry summarize`` runs on operator machines, where
 importing jax can acquire (and hang on) the TPU relay lease. Everything
 here is arithmetic over dicts.
@@ -27,6 +27,10 @@ from masters_thesis_tpu.telemetry.costs import (
     utilization as _roofline_utilization,
 )
 from masters_thesis_tpu.telemetry.events import read_events
+from masters_thesis_tpu.telemetry.quality import (
+    quality_report,
+    quality_violations,
+)
 
 EVENTS_FILENAME = "events.jsonl"
 
@@ -204,8 +208,24 @@ def summarize_events(events: list[dict]) -> dict:
             if (by_kind.get("alert_fired") or by_kind.get("alert_resolved"))
             else None
         ),
+        # Model-quality plane (telemetry/quality.py): drift-sample folding,
+        # breach counts, quality-rejected swaps. None for runs that never
+        # sampled — the section only appears once a monitor was attached.
+        "quality": (
+            quality_report(events)
+            if (
+                by_kind.get("quality_sample")
+                or any(
+                    str(e.get("reason") or "").startswith("quality")
+                    for e in by_kind.get("swap_rejected", [])
+                )
+            )
+            else None
+        ),
     }
-    report["violations"] = contract_violations(report)
+    report["violations"] = contract_violations(report) + quality_violations(
+        events, report["quality"]
+    )
     return report
 
 
@@ -672,6 +692,25 @@ def render_text(report: dict) -> str:
             f"{sv.get('swaps_rejected', 0)}-, "
             f"{sv.get('degradations', 0)} degradation(s)",
         )
+    q = report.get("quality")
+    if q:
+        last = q.get("last") or {}
+        br = q.get("breaches") or {}
+        qline = (
+            f"quality        : {q.get('samples', 0)} sample(s), "
+            f"input_psi {_fmt(last.get('input_psi'), '.3f')}, "
+            f"pred_psi {_fmt(last.get('pred_psi'), '.3f')}, "
+            f"shadow_err {_fmt(last.get('shadow_err'), '.3f')} | "
+            f"breaches input {br.get('input', 0)} / "
+            f"pred {br.get('prediction', 0)} / shadow {br.get('shadow', 0)}"
+        )
+        if q.get("swaps_rejected_quality"):
+            lr = q.get("last_rejection") or {}
+            qline += (
+                f" | {q['swaps_rejected_quality']} quality-rejected "
+                f"swap(s) (last: {lr.get('tag')} {lr.get('reason')})"
+            )
+        lines.insert(len(lines) - 1, qline)
     fl = report.get("fleet")
     if fl:
         cache = fl.get("cache") or {}

@@ -59,8 +59,20 @@ RULE_KINDS = frozenset(
         "starvation_pct",  # input-pipeline starvation % (slow window)
         "recompile",  # epoch-program compiles beyond the contract's one
         "divergence",  # a run halted on a non-finite loss
+        "input_drift",  # max feature PSI from quality_sample events
+        "prediction_drift",  # max predicted-(α,β) PSI from quality_sample
+        "shadow_disagreement",  # |model − shadow-OLS| EWMA from
+        # quality_sample events (telemetry/quality.py)
     }
 )
+
+#: The model-quality rule kinds and the ``quality_sample`` field each one
+#: reads (the monitor emits thresholds too, but the RULE owns its own).
+QUALITY_RULE_FIELDS = {
+    "input_drift": "input_psi",
+    "prediction_drift": "pred_psi",
+    "shadow_disagreement": "shadow_err",
+}
 
 #: Request statuses that consume error budget (a shed IS a user-visible
 #: non-answer; the no-late-answers invariant makes rejected_late one too).
@@ -202,6 +214,38 @@ def default_train_rules(
     ]
 
 
+def default_quality_rules(
+    input_threshold: float = 0.25,
+    prediction_threshold: float = 0.25,
+    shadow_threshold: float = 0.5,
+    fast_window_s: float = 60.0,
+    slow_window_s: float = 300.0,
+) -> list[SLORule]:
+    """Model-quality objectives over ``quality_sample`` events (the
+    serve-side 1-in-K sampler in telemetry/quality.py). PSI thresholds
+    read on the usual industry scale; the shadow threshold is a mean
+    |model − OLS| disagreement in (α, β) units."""
+    return [
+        SLORule(
+            "input-drift", "input_drift", threshold=input_threshold,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            for_ticks=2,
+        ),
+        SLORule(
+            "prediction-drift", "prediction_drift",
+            threshold=prediction_threshold,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            for_ticks=2,
+        ),
+        SLORule(
+            "shadow-disagreement", "shadow_disagreement",
+            threshold=shadow_threshold,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            for_ticks=2,
+        ),
+    ]
+
+
 @dataclass
 class _AlertState:
     """Debounced per-rule state machine: pending → firing → resolved."""
@@ -268,6 +312,8 @@ class SLOEngine:
         self._cursors: dict[Path, int] = {}
         self._requests: deque = deque()  # (ts, status, dur_s)
         self._epochs: deque = deque()  # (ts, wall_s, data_wait_s)
+        # (ts, scored, input_psi, pred_psi, shadow_err) quality samples.
+        self._quality: deque = deque()
         self._epoch_compiles = 0
         self._diverged = False
         self._divergence_detail: str | None = None
@@ -323,6 +369,17 @@ class SLOEngine:
                      float(ev.get("data_wait_s") or 0.0))
                 )
             self._epoch_compiles += int(ev.get("compile_events") or 0)  # mtt: disable=CL502 -- single-writer tick
+        elif kind == "quality_sample":
+            if ts is not None:
+                self._quality.append(
+                    (
+                        ts,
+                        bool(ev.get("scored")),
+                        float(ev.get("input_psi") or 0.0),
+                        float(ev.get("pred_psi") or 0.0),
+                        float(ev.get("shadow_err") or 0.0),
+                    )
+                )
         elif kind == "run_finished":
             self._stream_finished[path] = True
             if ev.get("diverged"):
@@ -340,6 +397,8 @@ class SLOEngine:
             self._requests.popleft()
         while self._epochs and self._epochs[0][0] < cutoff:
             self._epochs.popleft()
+        while self._quality and self._quality[0][0] < cutoff:
+            self._quality.popleft()
 
     # ---------------------------------------------------------- signals
 
@@ -421,6 +480,31 @@ class SLOEngine:
             return value, value > rule.threshold, {
                 "detail": self._divergence_detail
             }
+        if rule.kind in QUALITY_RULE_FIELDS:
+            # Drift signals are cumulative-sketch scores: the LATEST
+            # sample in the window is the current state (older samples
+            # were computed from a strictly smaller sketch). Shadow
+            # disagreement is an EWMA — same story. Drift kinds only
+            # consider scored samples (a reference fingerprint was
+            # loaded and the warm-up count was met).
+            idx = {
+                "input_drift": 2,
+                "prediction_drift": 3,
+                "shadow_disagreement": 4,
+            }[rule.kind]
+            cutoff = now - rule.fast_window_s
+            value = None
+            n = 0
+            for ts, scored, *vals in self._quality:
+                if ts < cutoff:
+                    continue
+                if rule.kind != "shadow_disagreement" and not scored:
+                    continue
+                n += 1
+                value = vals[idx - 2]
+            return value, (
+                value is not None and value > rule.threshold
+            ), {"samples_fast": n}
         raise AssertionError(f"unreachable rule kind {rule.kind!r}")
 
     # -------------------------------------------------------------- tick

@@ -34,6 +34,10 @@ from masters_thesis_tpu.telemetry.aggregate import (
     digest_events,
 )
 from masters_thesis_tpu.telemetry.events import read_new_lines
+from masters_thesis_tpu.telemetry.quality import (
+    quality_report,
+    render_quality,
+)
 from masters_thesis_tpu.telemetry.report import EVENTS_FILENAME, alert_state
 from masters_thesis_tpu.telemetry.slo import window_stats
 
@@ -95,6 +99,7 @@ class FleetWatch:
             "serve": self._serve_window(merged, now),
             "alerts": alert_state(merged),
             "replicas": replica_state(merged),
+            "quality": quality_report(merged),
         }
 
     def _merged_events(self) -> list[dict]:
@@ -200,6 +205,11 @@ def render_watch(snapshot: dict) -> str:
             f"replicas       : {replicas['live']}/"
             f"{len(replicas['replicas'])} live | {per}"
         )
+    quality = snapshot.get("quality")
+    if quality and (
+        quality.get("samples") or quality.get("swaps_rejected_quality")
+    ):
+        lines.append(render_quality(quality))
     alerts = snapshot.get("alerts") or {}
     active = alerts.get("active") or []
     if active:
@@ -307,6 +317,14 @@ def selfcheck() -> int:
                         burn_fast=5.0, burn_slow=4.0, active_s=None,
                     )
                     tel.event(
+                        "quality_sample", sampled=7, scored=True,
+                        input_psi=0.31, input_ks=0.2, pred_psi=0.05,
+                        pred_ks=0.04, shadow_err=0.12, shadow_thr=0.5,
+                        input_thr=0.25, pred_thr=0.25,
+                        input_breached=True, pred_breached=False,
+                        shadow_breached=False,
+                    )
+                    tel.event(
                         "run_finished", epochs=3, total_steps=12,
                         steps_per_sec=8.0, diverged=False, best_val=0.5,
                         epoch_compiles=1, eval_compiles=0,
@@ -324,8 +342,10 @@ def selfcheck() -> int:
                 )
             if snap["serve"] is None or snap["serve"]["n"] != 10:
                 failures.append(f"serve window {snap['serve']!r}")
+            if (snap.get("quality") or {}).get("samples") != 1:
+                failures.append(f"quality section {snap.get('quality')!r}")
             for needle in ("ALERTS FIRING", "error-budget-burn", "p0",
-                           "p1", "serving"):
+                           "p1", "serving", "QUALITY"):
                 if needle not in frame:
                     failures.append(f"frame missing {needle!r}")
             # A second refresh must be incremental: cursors already at

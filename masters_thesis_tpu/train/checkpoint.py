@@ -58,8 +58,17 @@ def save_checkpoint(
     opt_state: Any,
     spec: ModelSpec,
     meta: dict,
+    extra_files: dict[str, str] | None = None,
 ) -> None:
-    """Atomically write ``<ckpt_dir>/<tag>`` (orbax) + ``<tag>.json`` sidecar."""
+    """Atomically write ``<ckpt_dir>/<tag>`` (orbax) + ``<tag>.json`` sidecar.
+
+    ``extra_files`` maps filenames to text written INTO the staged tree
+    before its manifest — e.g. the trainer's ``quality.json`` model
+    fingerprint. They are therefore sha256-covered by ``MANIFEST.json``,
+    fsync'd with the tree, rotate to ``<tag>.prev`` with the pair, and a
+    torn or doctored copy fails strict verification exactly like a torn
+    checkpoint file.
+    """
     ckpt_dir = Path(ckpt_dir).resolve()
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     path = ckpt_dir / tag
@@ -110,6 +119,15 @@ def save_checkpoint(
         )
         ckptr.wait_until_finished()
     if jax.process_index() == 0:
+        # Extra sidecar files (quality fingerprint, ...) land inside the
+        # staged tree BEFORE the manifest walk so they get sha256+size
+        # coverage and ride every later rename with the data they
+        # describe. fsync before hashing: the manifest must describe
+        # bytes that are actually durable.
+        for name, text in (extra_files or {}).items():
+            target = staging / name
+            target.write_text(text)
+            fsync_path(target)
         # Content checksums INSIDE the staged tree: the manifest travels
         # through the publish renames with the data it describes, so a
         # torn or bit-flipped tree is detectable at restore time and can

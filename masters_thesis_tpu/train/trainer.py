@@ -59,12 +59,14 @@ from masters_thesis_tpu.train.flatparams import (
 )
 from masters_thesis_tpu.train.optim import PlateauScheduler
 from masters_thesis_tpu.train.steps import (
+    forward_rows,
     jit_cache_size,
     make_eval_fn,
     make_train_epoch,
     make_train_step,
     metric_means,
 )
+from masters_thesis_tpu.telemetry import quality as quality_lib
 
 EVAL_CHUNK = 32
 
@@ -426,10 +428,45 @@ class Trainer:
             )
         eval_fn = make_eval_fn(module, objective, self.mesh)
 
+        # Model-quality fingerprint (telemetry/quality.py): at checkpoint
+        # time a fixed slice of the val split plus a seeded golden batch is
+        # scored through the CURRENT params and the sketches ship as a
+        # quality.json sidecar covered by MANIFEST.json. Every rank computes
+        # the same fingerprint (SPMD-uniform — no rank-gated device work);
+        # only rank 0 writes, inside save_checkpoint's staging protocol.
+        self._quality_fp_fn = None
+        if self.ckpt_dir and val_prepared:
+            qx = np.asarray(dm.val_arrays().x[:128], np.float32)
+            gx = quality_lib.golden_windows(32, *qx.shape[1:], seed=0)
+
+            def _fingerprint(fp_params):
+                def _predict(x_np):
+                    a, b = forward_rows(module, fp_params, jnp.asarray(x_np))
+                    return (
+                        np.asarray(jax.device_get(a))[..., 0],
+                        np.asarray(jax.device_get(b))[..., 0],
+                    )
+
+                a_v, b_v = _predict(qx)
+                a_g, b_g = _predict(gx)
+                return quality_lib.build_fingerprint(
+                    qx, a_v, b_v, golden=(gx, a_g, b_g), golden_seed=0
+                )
+
+            self._quality_fp_fn = _fingerprint
+
         # Stream mode fills a fresh PrefetchStats per epoch so telemetry can
         # split epoch wall into device time vs host data-wait; scan mode has
         # no input pipeline (the split is device-resident).
         epoch_stats: dict[str, PrefetchStats | None] = {"cur": None}
+
+        # Armed by the trainer.epoch_start ``shift`` fault below: scan mode
+        # rewrites the device-resident split once at the epoch boundary,
+        # stream mode shifts each host batch as it is drawn — either way the
+        # shift persists for the rest of the run (a regime change, not a
+        # one-off glitch).
+        data_cell: dict[str, Any] = {}
+        shift_cell: dict[str, tuple[float, float] | None] = {"params": None}
 
         if self.epoch_mode == "scan":
             train_dev, n_local = self._device_train_split(dm.train_arrays())
@@ -440,10 +477,13 @@ class Trainer:
                 batch_size=b_local,
             )
             hot_fn = epoch_fn
+            data_cell["train"] = train_dev
 
             def run_epoch(params, opt_state, lr, epoch_rng, epoch):
                 # Shuffle happens on device (steps.py) — no index upload.
-                return epoch_fn(params, opt_state, lr, epoch_rng, train_dev)
+                return epoch_fn(
+                    params, opt_state, lr, epoch_rng, data_cell["train"]
+                )
 
         elif self.epoch_mode == "stream":
             global_b = dm.batch_size * self.n_dev
@@ -464,6 +504,13 @@ class Trainer:
             def weighted_batches(batches):
                 full_w = np.ones((global_b,), np.float32)
                 for b in batches:
+                    so = shift_cell["params"]
+                    if so is not None:
+                        b = b._replace(
+                            x=(b.x * so[0] + so[1]).astype(
+                                np.asarray(b.x).dtype
+                            )
+                        )
                     n = b.x.shape[0]
                     if n == global_b:
                         yield b, full_w
@@ -755,7 +802,21 @@ class Trainer:
             jax.block_until_ready(params)
 
         for epoch in range(start_epoch, self.max_epochs):
-            faults.fire("trainer.epoch_start", epoch=epoch)
+            fired = faults.fire("trainer.epoch_start", epoch=epoch)
+            if fired == "shift":
+                # Seeded regime shift on this epoch's (and every later
+                # epoch's) window features — the deterministic trigger for
+                # the quality plane's drift detectors. One device op at the
+                # epoch boundary in scan mode; host-side per batch in
+                # stream mode. The hot loop itself is untouched.
+                scale, offset = faults.shift_params(epoch)
+                if self.epoch_mode == "scan":
+                    cur = data_cell["train"]
+                    data_cell["train"] = cur._replace(
+                        x=(cur.x * scale + offset).astype(cur.x.dtype)
+                    )
+                else:
+                    shift_cell["params"] = (scale, offset)
             prof.maybe_start(epoch)
             if flight is not None:
                 # Progress marker for the hang watchdog (host memory only —
@@ -1000,8 +1061,24 @@ class Trainer:
             return
         t0_wall = time.time()
         t0 = time.perf_counter()
+        # Quality fingerprint sidecar: sketches of the val inputs, the
+        # predicted (alpha, beta) distributions, and the shadow-OLS
+        # disagreement under the params being saved. Best-effort — a
+        # fingerprint failure must never lose the checkpoint itself.
+        fp = extra = None
+        if getattr(self, "_quality_fp_fn", None) is not None:
+            try:
+                fp = self._quality_fp_fn(params)
+                extra = {
+                    quality_lib.FINGERPRINT_FILENAME:
+                        quality_lib.fingerprint_to_json(fp)
+                }
+            except Exception as e:
+                fp = extra = None
+                self._print(f"quality fingerprint failed for {tag!r}: {e}")
         ckpt_lib.save_checkpoint(
             self.ckpt_dir, tag, params, opt_state, spec,
+            extra_files=extra,
             meta={
                 "epoch": epoch,
                 "val_loss": float(val_loss),
@@ -1031,6 +1108,14 @@ class Trainer:
                 wall_s=wall_s,
                 path=str(self.ckpt_dir / tag),
             )
+            if fp is not None:
+                self.telemetry.event(
+                    "quality_fingerprint",
+                    tag=tag,
+                    epoch=int(epoch),
+                    windows=int(fp["windows"]),
+                    shadow_err=float(fp["shadow"]["err_mean"]),
+                )
             self.telemetry.tracer.emit_span(
                 "train.checkpoint",
                 start_ts=t0_wall,

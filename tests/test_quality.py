@@ -1,0 +1,652 @@
+"""Model-quality plane (ISSUE 18): drift sketches, shadow-OLS monitoring,
+quality-gated hot-swap.
+
+Covers all three lifecycle stages plus the chaos contract the issue
+names in BOTH directions: the ``shift`` fault fires the input-drift and
+shadow-disagreement alerts within a bounded number of sampled windows,
+while an IID twin run stays silent; the swap quality gate rejects a
+diverged fine-tune with a named ``quality_*`` reason while an honest
+candidate (and a fingerprint-less legacy checkpoint) still commits.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.faults import FaultPlan, FaultSpec
+from masters_thesis_tpu.telemetry import TelemetryRun, read_events
+from masters_thesis_tpu.telemetry import quality as q
+from masters_thesis_tpu.telemetry.__main__ import main as cli_main
+from masters_thesis_tpu.telemetry.report import summarize_events
+from masters_thesis_tpu.telemetry.slo import SLOEngine, default_quality_rules
+
+# Window shape shared by every engine/checkpoint in this file (matches
+# test_serve.py so the AOT predict programs stay tiny).
+K, T, F = 4, 8, 3
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults(monkeypatch):
+    """Every test starts and ends with injection off, whatever it does."""
+    monkeypatch.delenv(faults.FAULT_PLAN_ENV, raising=False)
+    monkeypatch.delenv(faults.ATTEMPT_ENV, raising=False)
+    yield
+    faults.clear_plan()
+
+
+def _windows(n, n_stocks=6, lookback=32, n_features=3,
+             scale=1.0, offset=0.0, seed=11):
+    """Seeded window batch + its honest shadow-OLS outputs."""
+    g = np.random.default_rng(seed)
+    xs = g.standard_normal((n, n_stocks, lookback, n_features))
+    xs = (xs * scale + offset).astype(np.float32)
+    a, b = q.shadow_ols(xs)
+    return xs, a, b
+
+
+# ------------------------------------------------------------- sketch math
+
+
+class TestSketchMath:
+    def test_p2_quantiles_track_exact(self):
+        data = np.random.default_rng(0).standard_normal(5000)
+        sk = q.StreamSketch()
+        sk.update(data)
+        got = np.asarray(sk.summary()["quantiles"])
+        want = np.quantile(data, np.asarray(q.QUANTILE_GRID))
+        assert np.all(np.abs(got - want) < 0.08)
+
+    def test_from_values_is_exact_and_matches_streaming_moments(self):
+        data = np.random.default_rng(1).standard_normal(3000)
+        exact = q.StreamSketch.from_values(data).summary()
+        assert exact["quantiles"] == [
+            float(np.quantile(data, p)) for p in q.QUANTILE_GRID
+        ]
+        streamed = q.StreamSketch()
+        streamed.update(data)
+        s = streamed.summary()
+        assert s["count"] == exact["count"] == data.size
+        assert s["mean"] == pytest.approx(exact["mean"], abs=1e-9)
+        assert s["var"] == pytest.approx(exact["var"], rel=1e-6)
+        assert (s["min"], s["max"]) == (exact["min"], exact["max"])
+
+    def test_nonfinite_values_are_dropped(self):
+        sk = q.StreamSketch()
+        sk.update([1.0, np.nan, 2.0, np.inf, -np.inf, 3.0])
+        assert sk.count == 3
+        assert sk.summary()["max"] == 3.0
+
+    def test_psi_ks_quiet_on_iid_loud_under_shift(self):
+        base = np.random.default_rng(2).standard_normal(20_000)
+        ref = q.StreamSketch.from_values(base[:10_000]).summary()
+        iid = q.StreamSketch.from_values(base[10_000:]).summary()
+        shifted = q.StreamSketch.from_values(
+            base[10_000:] * 1.5 + 0.75
+        ).summary()
+        assert q.psi(ref, iid) < 0.02 and q.ks(ref, iid) < 0.03
+        assert q.psi(ref, shifted) > 0.3 and q.ks(ref, shifted) > 0.2
+        # Empty sketches never alarm.
+        empty = q.StreamSketch().summary()
+        assert q.psi(ref, empty) == 0.0 and q.ks(empty, ref) == 0.0
+
+    def test_sketch_json_round_trip_is_bit_stable(self):
+        ref = q.StreamSketch.from_values(
+            np.random.default_rng(3).standard_normal(500)
+        ).summary()
+        js = q.sketch_to_json(ref)
+        assert q.sketch_to_json(q.sketch_from_json(js)) == js
+
+    def test_shadow_ols_matches_per_window_polyfit(self):
+        x = np.random.default_rng(4).standard_normal((3, 5, 24, 3))
+        sa, sb = q.shadow_ols(x)
+        assert sa.shape == sb.shape == (3, 5)
+        for n in range(3):
+            for k in range(5):
+                b1, b0 = np.polyfit(x[n, 0, :, 1], x[n, k, :, 0], 1)
+                assert sa[n, k] == pytest.approx(b0, abs=1e-8)
+                assert sb[n, k] == pytest.approx(b1, abs=1e-8)
+        # A model that IS the OLS baseline has zero shadow disagreement.
+        assert q.shadow_error(x, sa, sb) == pytest.approx(0.0, abs=1e-9)
+
+    def test_golden_windows_deterministic(self):
+        a = q.golden_windows(4, K, T, F, seed=0)
+        b = q.golden_windows(4, K, T, F, seed=0)
+        c = q.golden_windows(4, K, T, F, seed=1)
+        assert a.shape == (4, K, T, F) and a.dtype == np.float32
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+
+# ------------------------------------------------------------ fingerprints
+
+
+class TestFingerprint:
+    def test_build_sections_and_json_round_trip(self):
+        fx, fa, fb = _windows(40)
+        gx = q.golden_windows(8, 6, 32, 3, seed=0)
+        ga, gb = q.shadow_ols(gx)
+        fp = q.build_fingerprint(
+            fx, fa, fb, golden=(gx, ga, gb), golden_seed=0, max_windows=32
+        )
+        assert fp["windows"] == 32  # capped by max_windows
+        assert fp["window_shape"] == [6, 32, 3]
+        assert set(fp["features"]) == {"0", "1", "2"}
+        assert fp["shadow"]["err_mean"] == pytest.approx(0.0, abs=1e-9)
+        assert fp["golden"]["shape"] == [8, 6, 32, 3]
+        assert fp["golden"]["seed"] == 0
+        js = q.fingerprint_to_json(fp)
+        assert q.fingerprint_to_json(json.loads(js)) == js
+
+    def test_read_fingerprint_missing_or_torn_is_none(self, tmp_path):
+        assert q.read_fingerprint(tmp_path / "nope") is None
+        tree = tmp_path / "best"
+        tree.mkdir()
+        (tree / q.FINGERPRINT_FILENAME).write_text("{torn")
+        assert q.read_fingerprint(tree) is None
+        (tree / q.FINGERPRINT_FILENAME).write_text('{"version": 1}')
+        assert q.read_fingerprint(tree) == {"version": 1}
+
+
+# ------------------------------------------------------- the `shift` fault
+
+
+class TestShiftFault:
+    def test_shift_is_a_declared_kind(self):
+        spec = FaultSpec(point="serve.admit", kind="shift", attempt=None)
+        assert spec.kind == "shift"
+        with pytest.raises(ValueError):
+            FaultSpec(point="serve.admit", kind="wobble")
+
+    def test_shift_params_seeded_and_bounded(self):
+        faults.install_plan(FaultPlan(faults=(), seed=5))
+        try:
+            s1 = faults.shift_params()
+            s2 = faults.shift_params()
+        finally:
+            faults.clear_plan()
+        faults.install_plan(FaultPlan(faults=(), seed=6))
+        try:
+            s3 = faults.shift_params()
+            s4 = faults.shift_params(extra=1)
+        finally:
+            faults.clear_plan()
+        assert s1 == s2  # same plan seed -> same regime
+        assert s1 != s3  # different plan seed -> different regime
+        assert s3 != s4  # per-epoch `extra` decorrelates
+        for scale, off in (s1, s3, s4):
+            assert 1.25 <= scale <= 1.75
+            assert 0.25 <= off <= 0.75
+
+    def test_admit_shift_transforms_the_request_deterministically(self):
+        from masters_thesis_tpu.serve.queue import (
+            MicroBatchQueue,
+            ServeRequest,
+        )
+
+        faults.install_plan(
+            FaultPlan(
+                faults=(
+                    FaultSpec(point="serve.admit", kind="shift", attempt=None),
+                ),
+                seed=5,
+            )
+        )
+        try:
+            scale, offset = faults.shift_params()
+            queue = MicroBatchQueue(max_batch=2)
+            x = np.ones((K, T, F), np.float32)
+            req = ServeRequest(
+                rid=1, x=x.copy(), deadline_ts=time.monotonic() + 10.0
+            )
+            pending = queue.submit(req)
+        finally:
+            faults.clear_plan()
+        assert not pending.done  # shifted, not shed: still serveable
+        assert pending.request.x.dtype == np.float32
+        np.testing.assert_allclose(
+            pending.request.x, x * scale + offset, rtol=1e-6
+        )
+
+
+# --------------------------------------------- monitor + SLO chaos (e2e)
+
+
+class TestMonitorAndSLO:
+    @pytest.fixture(scope="class")
+    def reference_fp(self):
+        fx, fa, fb = _windows(64, seed=11)
+        return q.build_fingerprint(fx, fa, fb)
+
+    def _run_stream(self, tmp_path, reference_fp, *, run_id, m=48,
+                    doctor=None, **window_kw):
+        tel = TelemetryRun(tmp_path, run_id=run_id)
+        mon = q.QualityMonitor(
+            reference_fp, sample_every=1, min_samples=8, telemetry=tel
+        )
+        engine = SLOEngine(
+            tel.run_dir,
+            rules=default_quality_rules(
+                fast_window_s=300.0, slow_window_s=300.0
+            ),
+            sink=tel.sink,
+        )
+        xs, a, b = _windows(m, **window_kw)
+        if doctor is not None:
+            a, b = doctor(a, b)
+        for i in range(m):
+            mon.sample(xs[i], a[i], b[i])
+        states = [engine.tick(), engine.tick()]  # for_ticks=2 debounce
+        tel.close()
+        return mon, states, read_events(tel.run_dir / "events.jsonl")
+
+    def test_iid_twin_stays_silent(self, tmp_path, reference_fp):
+        mon, states, events = self._run_stream(
+            tmp_path, reference_fp, run_id="q-iid", seed=12
+        )
+        assert states[-1]["firing"] == []
+        assert not any(e["kind"] == "alert_fired" for e in events)
+        last = mon.last_scores()
+        assert last["scored"] and not last["input_breached"]
+
+    def test_shift_fires_input_drift_alert(self, tmp_path, reference_fp):
+        mon, states, events = self._run_stream(
+            tmp_path, reference_fp, run_id="q-shift",
+            scale=1.6, offset=0.8, seed=13,
+        )
+        assert "input-drift" in states[-1]["firing"]
+        fired = [e for e in events if e["kind"] == "alert_fired"]
+        assert any(e["slo_kind"] == "input_drift" for e in fired)
+        # The honest-OLS predictions keep the shadow detector quiet, so
+        # the sustained-breach-without-alert contract stays clean.
+        assert q.quality_violations(events) == []
+        rep = q.quality_report(events)
+        assert rep["samples"] == 48
+        assert rep["breaches"]["input"] > 0
+        assert rep["alerts_fired"] >= 1
+
+    def test_garbage_predictions_fire_shadow_alert(
+        self, tmp_path, reference_fp
+    ):
+        mon, states, events = self._run_stream(
+            tmp_path, reference_fp, run_id="q-shadow", seed=14,
+            doctor=lambda a, b: (a * 40.0 + 3.0, b * 40.0),
+        )
+        assert "shadow-disagreement" in states[-1]["firing"]
+        assert q.quality_violations(events) == []  # breach DID alert
+        assert q.quality_report(events)["breaches"]["shadow"] > 0
+
+    def test_live_summaries_gate_on_min_samples(self, reference_fp):
+        mon = q.QualityMonitor(reference_fp, sample_every=1, min_samples=4)
+        xs, a, b = _windows(6, seed=15)
+        for i in range(3):
+            mon.sample(xs[i], a[i], b[i])
+        assert mon.live_summaries() == {}
+        for i in range(3, 6):
+            mon.sample(xs[i], a[i], b[i])
+        live = mon.live_summaries()
+        assert live["sampled"] == 6
+        assert live["alpha"]["count"] > 0
+        # set_reference re-baselines and restarts the live sketches.
+        mon.set_reference(reference_fp)
+        assert mon.live_summaries() == {}
+
+    def test_sampling_rate_one_in_k(self, reference_fp):
+        mon = q.QualityMonitor(reference_fp, sample_every=4, min_samples=2)
+        xs, a, b = _windows(16, seed=16)
+        sampled = [
+            mon.sample(xs[i], a[i], b[i]) is not None for i in range(16)
+        ]
+        assert sum(sampled) == 4
+        assert sampled[0]  # first delivery is always sampled
+
+
+# --------------------------------------- checkpoint sidecar (MANIFEST.json)
+
+
+def _tiny_spec():
+    from masters_thesis_tpu.models.objectives import ModelSpec
+
+    return ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        kernel_impl="xla",
+    )
+
+
+def _init_params(spec, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    return module.init(
+        jax.random.key(seed), jnp.zeros((1, T, F), jnp.float32)
+    )["params"]
+
+
+def _save_ckpt(d, spec, params, epoch, extra_files=None):
+    from masters_thesis_tpu.train.checkpoint import save_checkpoint
+
+    save_checkpoint(
+        Path(d), "best", params, {}, spec,
+        meta={"epoch": epoch, "datamodule": {"lookback_window": T}},
+        extra_files=extra_files,
+    )
+
+
+class TestQualitySidecar:
+    def _fingerprint_json(self):
+        fx, fa, fb = _windows(16, n_stocks=K, lookback=T, n_features=F)
+        return q.fingerprint_to_json(q.build_fingerprint(fx, fa, fb))
+
+    def test_sidecar_is_manifest_covered_and_verifies(self, tmp_path):
+        from masters_thesis_tpu.train.checkpoint import verify_checkpoint
+
+        spec = _tiny_spec()
+        _save_ckpt(
+            tmp_path, spec, _init_params(spec), epoch=0,
+            extra_files={q.FINGERPRINT_FILENAME: self._fingerprint_json()},
+        )
+        tree = tmp_path / "best"
+        sidecar = tree / q.FINGERPRINT_FILENAME
+        assert sidecar.exists()
+        manifest = json.loads((tree / "MANIFEST.json").read_text())
+        assert q.FINGERPRINT_FILENAME in manifest["files"]
+        assert (
+            manifest["files"][q.FINGERPRINT_FILENAME]["size"]
+            == sidecar.stat().st_size
+        )
+        assert verify_checkpoint(tree, require_manifest=True)
+        assert q.read_fingerprint(tree)["windows"] == 16
+
+    def test_torn_sidecar_fails_strict_verify(self, tmp_path):
+        from masters_thesis_tpu.train.checkpoint import verify_checkpoint
+
+        spec = _tiny_spec()
+        _save_ckpt(
+            tmp_path, spec, _init_params(spec), epoch=0,
+            extra_files={q.FINGERPRINT_FILENAME: self._fingerprint_json()},
+        )
+        tree = tmp_path / "best"
+        sidecar = tree / q.FINGERPRINT_FILENAME
+        raw = bytearray(sidecar.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        sidecar.write_bytes(bytes(raw))
+        assert not verify_checkpoint(tree, require_manifest=True)
+
+    def test_rotation_keeps_prev_sidecar(self, tmp_path):
+        spec = _tiny_spec()
+        js = self._fingerprint_json()
+        _save_ckpt(tmp_path, spec, _init_params(spec, 0), epoch=0,
+                   extra_files={q.FINGERPRINT_FILENAME: js})
+        _save_ckpt(tmp_path, spec, _init_params(spec, 1), epoch=1,
+                   extra_files={q.FINGERPRINT_FILENAME: js})
+        assert (tmp_path / "best" / q.FINGERPRINT_FILENAME).exists()
+        assert (tmp_path / "best.prev" / q.FINGERPRINT_FILENAME).exists()
+
+
+# ------------------------------------------------- quality-gated hot-swap
+
+
+@pytest.fixture
+def swap_setup(tmp_path):
+    from masters_thesis_tpu.serve.engine import PredictEngine
+
+    d = tmp_path / "ckpts"
+    spec = _tiny_spec()
+    _save_ckpt(d, spec, _init_params(spec, seed=0), epoch=0)
+    engine = PredictEngine.from_checkpoint(
+        d, "best", n_stocks=K, n_features=F, buckets=(1,)
+    )
+    engine.warmup()
+    return d, spec, engine
+
+
+def _candidate_outputs(engine, params):
+    """Candidate outputs on the seed-0 golden windows, host-side. One
+    window at a time — the fixture engine only compiles bucket 1, which
+    is exactly the mismatch the swapper's chunked predict must absorb."""
+    gx = q.golden_windows(8, K, T, F, seed=0)
+    dev = engine.put_params(params)
+    outs = [engine.predict(gx[i : i + 1], params=dev) for i in range(len(gx))]
+    ga = np.concatenate([np.asarray(o[0]) for o in outs])
+    gb = np.concatenate([np.asarray(o[1]) for o in outs])
+    return gx, ga, gb
+
+
+class TestSwapQualityGate:
+    def test_honest_fingerprint_commits_and_rebaselines(
+        self, swap_setup, tmp_path
+    ):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        d, spec, engine = swap_setup
+        mon = q.QualityMonitor(None, sample_every=1)
+        tel = TelemetryRun(tmp_path / "tel", run_id="swap-q-ok")
+        swapper = CheckpointSwapper(
+            engine, telemetry=tel, quality_monitor=mon
+        )
+        cand = _init_params(spec, seed=7)
+        gx, ga, gb = _candidate_outputs(engine, cand)
+        fp = q.build_fingerprint(
+            gx, ga, gb, golden=(gx, ga, gb), golden_seed=0
+        )
+        _save_ckpt(
+            d, spec, cand, epoch=1,
+            extra_files={q.FINGERPRINT_FILENAME: q.fingerprint_to_json(fp)},
+        )
+        verdict = swapper.try_swap(d)
+        tel.close()
+        assert verdict.ok and verdict.reason == "committed"
+        # The gate actually ran: its scores ride on the commit verdict.
+        assert "quality_self_ks" in verdict.checks
+        assert verdict.checks["quality_self_ks"] < q.GATE_MAX_SELF_KS
+        # A committed swap re-baselines the live monitor to the NEW
+        # fingerprint (an intentional retrain must not alarm against the
+        # old model's sketches).
+        assert mon.reference is not None
+        assert mon.reference["golden"]["seed"] == 0
+        committed = [
+            e for e in read_events(tel.run_dir / "events.jsonl")
+            if e["kind"] == "swap_committed"
+        ]
+        assert len(committed) == 1
+
+    def test_diverged_finetune_rejected_with_named_reason(
+        self, swap_setup, tmp_path
+    ):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        d, spec, engine = swap_setup
+        tel = TelemetryRun(tmp_path / "tel", run_id="swap-q-bad")
+        swapper = CheckpointSwapper(engine, telemetry=tel)
+        before = engine.predict(swapper.golden_x)
+        cand = _init_params(spec, seed=8)
+        gx, ga, gb = _candidate_outputs(engine, cand)
+        # The shipped fingerprint claims output sketches the candidate
+        # does NOT produce — the diverged-between-fingerprint-and-deploy
+        # case the gate exists to catch.
+        fp = q.build_fingerprint(
+            gx, ga * 50.0 + 5.0, gb * 50.0,
+            golden=(gx, ga * 50.0 + 5.0, gb * 50.0), golden_seed=0,
+        )
+        _save_ckpt(
+            d, spec, cand, epoch=1,
+            extra_files={q.FINGERPRINT_FILENAME: q.fingerprint_to_json(fp)},
+        )
+        verdict = swapper.try_swap(d)
+        tel.close()
+        assert not verdict.ok
+        assert verdict.reason.startswith("quality_")
+        assert swapper.rejected == 1 and swapper.committed == 0
+        # Output parity: the replica keeps serving the exact old params.
+        after = engine.predict(swapper.golden_x)
+        assert np.array_equal(np.asarray(before[0]), np.asarray(after[0]))
+        events = read_events(tel.run_dir / "events.jsonl")
+        rejected = [e for e in events if e["kind"] == "swap_rejected"]
+        assert len(rejected) == 1
+        assert rejected[0]["reason"].startswith("quality_")
+        assert "quality_self_ks" in rejected[0]["checks"]
+        # The quality section of the post-hoc report names the rejection.
+        rep = summarize_events(events)
+        assert rep["quality"]["swaps_rejected_quality"] == 1
+        assert rep["quality"]["last_rejection"]["reason"].startswith(
+            "quality_"
+        )
+
+    def test_legacy_checkpoint_without_fingerprint_still_commits(
+        self, swap_setup
+    ):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        d, spec, engine = swap_setup
+        swapper = CheckpointSwapper(engine)  # no monitor attached
+        _save_ckpt(d, spec, _init_params(spec, seed=7), epoch=1)
+        verdict = swapper.try_swap(d)
+        assert verdict.ok and verdict.reason == "committed"
+        # No fingerprint and no live sketch: the gate never scored.
+        assert "quality_self_ks" not in verdict.checks
+
+
+# ------------------------------------------------ trainer fingerprinting
+
+
+@pytest.mark.slow
+class TestTrainerFingerprint:
+    def test_fit_ships_quality_sidecar(self, tmp_path):
+        from masters_thesis_tpu.data.pipeline import FinancialWindowDataModule
+        from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+        from masters_thesis_tpu.models.objectives import ModelSpec
+        from masters_thesis_tpu.train import Trainer
+        from masters_thesis_tpu.train.checkpoint import verify_checkpoint
+
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+            n_stocks=8, n_samples=2000, seed=1
+        )
+        np.save(data_dir / "stocks.npy", np.asarray(r_stocks))
+        np.save(data_dir / "market.npy", np.asarray(r_market))
+        np.save(data_dir / "alphas.npy", np.asarray(alphas))
+        np.save(data_dir / "betas.npy", np.asarray(betas))
+        dm = FinancialWindowDataModule(
+            data_dir, lookback_window=16, target_window=8, stride=24,
+            batch_size=2,
+        )
+        dm.prepare_data(verbose=False)
+        dm.setup()
+        tel = TelemetryRun(tmp_path / "tel", run_id="fp-fit")
+        trainer = Trainer(
+            max_epochs=1, check_val_every_n_epoch=1,
+            enable_progress_bar=False, enable_model_summary=False,
+            seed=0, strategy="tpu_xla", telemetry=tel,
+            ckpt_dir=tmp_path / "ckpts",
+        )
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            learning_rate=1e-2,
+        )
+        trainer.fit(spec, dm)
+        tel.close()
+        trees = [
+            p for p in (tmp_path / "ckpts").iterdir()
+            if p.is_dir() and not p.name.endswith(".prev")
+        ]
+        assert trees, "fit saved no checkpoint"
+        for tree in trees:
+            fp = q.read_fingerprint(tree)
+            assert fp is not None, f"{tree.name} shipped no quality.json"
+            assert fp["windows"] > 0
+            assert fp["golden"]["shape"][0] == 32  # trainer's golden count
+            assert fp["golden"]["shape"][2] == 16  # lookback window
+            manifest = json.loads((tree / "MANIFEST.json").read_text())
+            assert q.FINGERPRINT_FILENAME in manifest["files"]
+            assert verify_checkpoint(tree, require_manifest=True)
+        events = read_events(tel.run_dir / "events.jsonl")
+        fp_events = [e for e in events if e["kind"] == "quality_fingerprint"]
+        assert fp_events and fp_events[0]["windows"] > 0
+
+
+# ------------------------------------------------- CLI + report surfaces
+
+
+class TestQualityCLI:
+    def test_selfcheck(self):
+        assert cli_main(["quality", "--selfcheck"]) == 0
+
+    def test_missing_root_errors(self, tmp_path):
+        assert cli_main(["quality", str(tmp_path / "nope")]) == 1
+        assert cli_main(["quality"]) == 1
+
+    def _emit(self, tel, n, **overrides):
+        base = dict(
+            scored=True, input_psi=0.01, input_ks=0.01, pred_psi=0.01,
+            pred_ks=0.01, shadow_err=0.05, input_thr=0.25, pred_thr=0.25,
+            shadow_thr=0.5, input_breached=False, pred_breached=False,
+            shadow_breached=False,
+        )
+        base.update(overrides)
+        for i in range(n):
+            tel.event("quality_sample", sampled=i + 1, **base)
+
+    def test_clean_run_exits_zero(self, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="q-clean")
+        self._emit(tel, 4)
+        tel.close()
+        assert cli_main(["quality", str(tmp_path)]) == 0
+        assert cli_main(["quality", str(tmp_path), "--json"]) == 0
+
+    def test_breach_without_alert_is_a_violation_exit_2(
+        self, tmp_path, capsys
+    ):
+        tel = TelemetryRun(tmp_path, run_id="q-viol")
+        self._emit(tel, 4, shadow_err=0.9, shadow_breached=True)
+        tel.event("slo_snapshot")  # an SLO engine WAS attached
+        tel.close()
+        events = read_events(tmp_path / "events.jsonl")
+        assert len(q.quality_violations(events)) == 1
+        assert cli_main(["quality", str(tmp_path)]) == 2
+        out = capsys.readouterr().out
+        assert "QUALITY" in out
+        assert "CONTRACT VIOLATION" in out
+        # --json carries the same verdict machine-readably.
+        assert cli_main(["quality", str(tmp_path), "--json"]) == 2
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["violations"]
+        assert payload["quality"]["breaches"]["shadow"] == 4
+        # The same violation surfaces in the full summarize report.
+        rep = summarize_events(events)
+        assert any("shadow" in v for v in rep["violations"])
+
+    def test_alerted_breach_is_not_a_violation(self, tmp_path):
+        tel = TelemetryRun(tmp_path, run_id="q-alerted")
+        self._emit(tel, 4, shadow_err=0.9, shadow_breached=True)
+        tel.event("slo_snapshot")
+        tel.event(
+            "alert_fired", rule="shadow-disagreement",
+            slo_kind="shadow_disagreement", value=0.9, threshold=0.5,
+        )
+        tel.close()
+        events = read_events(tmp_path / "events.jsonl")
+        assert q.quality_violations(events) == []
+        # Exit is still 2 — a breach is a breach — but with no violation.
+        assert cli_main(["quality", str(tmp_path)]) == 2
+
+    def test_render_marks_breaches(self):
+        rep = q.quality_report(
+            [
+                {
+                    "kind": "quality_sample", "sampled": 1, "scored": True,
+                    "input_psi": 0.31, "pred_psi": 0.02, "shadow_err": 0.1,
+                    "input_breached": True, "pred_breached": False,
+                    "shadow_breached": False,
+                }
+            ]
+        )
+        line = q.render_quality(rep)
+        assert line.startswith("QUALITY")
+        assert "input_psi=0.310!" in line
+        assert "pred_psi=0.020" in line and "pred_psi=0.020!" not in line
+        assert q.render_quality({}) == "QUALITY   (no sampled windows)"

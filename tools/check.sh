@@ -72,6 +72,8 @@ echo "== telemetry trace selfcheck =="
 python -m masters_thesis_tpu.telemetry trace --selfcheck || fail=1
 echo "== telemetry watch selfcheck =="
 python -m masters_thesis_tpu.telemetry watch --selfcheck || fail=1
+echo "== telemetry quality selfcheck =="
+python -m masters_thesis_tpu.telemetry quality --selfcheck || fail=1
 
 # 3b. resilience: supervisor end-to-end against jax-free workers
 #     (preempt -> resume, deterministic crash -> halt, NaN -> rollback)
