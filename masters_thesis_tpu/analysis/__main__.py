@@ -91,6 +91,22 @@ def main(argv: list[str] | None = None) -> int:
         "0 skips it",
     )
     parser.add_argument(
+        "--n-factors",
+        type=int,
+        default=1,
+        metavar="K",
+        help="factor count of the audited model/window schema (1 = the "
+        "scalar market-model default; >1 audits the K-factor program)",
+    )
+    parser.add_argument(
+        "--shard-axis",
+        choices=("window", "asset"),
+        default="window",
+        help="train-split shard axis the audit builds the epoch program "
+        "with ('asset' = the universe-scale mode; the factor leaf stays "
+        "replicated by design)",
+    )
+    parser.add_argument(
         "--concurrency",
         action="store_true",
         help="run only the Pass-3 concurrency lint (CL501-CL505)",
@@ -200,10 +216,20 @@ def main(argv: list[str] | None = None) -> int:
         _force_cpu_mesh(args.trace_devices)
         from masters_thesis_tpu.analysis.traceaudit import run_trace_audit
 
+        spec = None
+        if args.n_factors != 1:
+            from masters_thesis_tpu.models.objectives import ModelSpec
+
+            spec = ModelSpec(
+                objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+                kernel_impl="xla", n_factors=args.n_factors,
+            )
         findings.extend(
             run_trace_audit(
+                spec=spec,
                 steps=args.trace_steps,
                 stacked_replicas=args.stacked_replicas or None,
+                shard_axis=args.shard_axis,
             )
         )
 

@@ -94,16 +94,38 @@ class PreflightError(RuntimeError):
         )
 
 
-def _synthetic_split(n_windows: int, rng: np.random.Generator):
+def _synthetic_split(
+    n_windows: int,
+    rng: np.random.Generator,
+    n_factors: int = 1,
+    n_stocks: int = AUDIT_STOCKS,
+):
     """A Batch-shaped train split with the pipeline's window schema:
-    x (N,K,T,F), y (N,K,T,4), factor (N,2), inv_psi (N,K)."""
+    x (N,K,T,2F+1), y (N,K,T,2F+2), factor (N,F+F²), inv_psi (N,K).
+    At ``n_factors=1`` this is the original scalar schema (features=3,
+    y channels=4, factor=(mean, var))."""
     from masters_thesis_tpu.data.pipeline import Batch
 
-    k, t, f = AUDIT_STOCKS, AUDIT_LOOKBACK, AUDIT_FEATURES
+    k, t, nf = n_stocks, AUDIT_LOOKBACK, n_factors
+    if nf == 1:
+        factor = (
+            np.abs(rng.standard_normal((n_windows, 2))).astype(np.float32)
+            + 0.1
+        )
+    else:
+        # [f_mean | f_cov.ravel()] with an SPD covariance per window, so the
+        # K-factor NLL's slogdet/solve path stays finite under the audit.
+        f_mean = rng.standard_normal((n_windows, nf)).astype(np.float32)
+        a = rng.standard_normal((n_windows, nf, nf)).astype(np.float32)
+        f_cov = np.einsum("wij,wkj->wik", a, a) / nf
+        f_cov += 0.1 * np.eye(nf, dtype=np.float32)
+        factor = np.concatenate(
+            [f_mean, f_cov.reshape(n_windows, -1)], axis=-1
+        ).astype(np.float32)
     return Batch(
-        rng.standard_normal((n_windows, k, t, f)).astype(np.float32),
-        rng.standard_normal((n_windows, k, t, 4)).astype(np.float32),
-        np.abs(rng.standard_normal((n_windows, 2))).astype(np.float32) + 0.1,
+        rng.standard_normal((n_windows, k, t, 2 * nf + 1)).astype(np.float32),
+        rng.standard_normal((n_windows, k, t, 2 * nf + 2)).astype(np.float32),
+        factor,
         np.ones((n_windows, k), np.float32),
     )
 
@@ -125,16 +147,22 @@ def run_trace_audit(
     steps: int = AUDIT_STEPS,
     check_collectives: bool = True,
     stacked_replicas: int | None = None,
+    shard_axis: str = "window",
 ) -> list[Finding]:
     """Build + run the real epoch program on synthetic data; return findings.
 
     ``spec`` (ModelSpec) and ``mesh`` default to a tiny MSE model over all
-    visible devices. With ``stacked_replicas`` set, the stacked epoch
-    program is audited too (TA207). Returns an empty list when every
-    invariant holds.
+    visible devices; the audit geometry follows ``spec.n_factors`` (K-factor
+    window schema). With ``stacked_replicas`` set, the stacked epoch
+    program is audited too (TA207). ``shard_axis='asset'`` audits the
+    universe-scale program: the split shards on the asset axis, the factor
+    leaf stays replicated by design, and TA203's data check adapts
+    accordingly. Returns an empty list when every invariant holds.
     """
     try:
-        findings = _run_trace_audit(spec, mesh, steps, check_collectives)
+        findings = _run_trace_audit(
+            spec, mesh, steps, check_collectives, shard_axis
+        )
     except Exception as exc:  # noqa: BLE001 — TA205 carries the cause
         return [
             Finding(
@@ -210,11 +238,15 @@ def _run_stacked_trace_audit(spec, mesh, replicas, steps) -> list[Finding]:
 
     module = spec.build_module()
     tx = FlatAdam(None, spec.weight_decay)
+    n_factors = getattr(spec, "n_factors", 1)
     split = _synthetic_split(
-        mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0)
+        mesh.size * AUDIT_BATCH * 2, np.random.default_rng(0),
+        n_factors=n_factors,
     )
 
-    dummy = jnp.zeros((1, AUDIT_LOOKBACK, AUDIT_FEATURES), jnp.float32)
+    dummy = jnp.zeros(
+        (1, AUDIT_LOOKBACK, 2 * n_factors + 1), jnp.float32
+    )
 
     def init(seed):
         return module.init(jax.random.key(seed), dummy)["params"]
@@ -289,7 +321,9 @@ def _run_stacked_trace_audit(spec, mesh, replicas, steps) -> list[Finding]:
     return findings
 
 
-def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
+def _run_trace_audit(
+    spec, mesh, steps, check_collectives, shard_axis="window"
+) -> list[Finding]:
     from masters_thesis_tpu.models.objectives import ModelSpec
     from masters_thesis_tpu.parallel import (
         batch_sharding,
@@ -311,16 +345,28 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
 
     module = spec.build_module()
     objective = spec.window_objective()
+    n_factors = getattr(spec, "n_factors", 1)
     # The audit runs the flat update path — the one the Trainer runs — so
     # TA206's "one collective per step" is checked on the real program.
     tx = FlatAdam(None, spec.weight_decay)
 
     rng = np.random.default_rng(0)
-    n_windows = mesh.size * AUDIT_BATCH * 2
-    split = _synthetic_split(n_windows, rng)
+    if shard_axis == "asset":
+        # Asset mode: every device sees all windows; the cross-section is
+        # what shards, so it must cover the mesh.
+        n_windows = AUDIT_BATCH * 2
+        n_stocks = mesh.size * AUDIT_STOCKS
+    else:
+        n_windows = mesh.size * AUDIT_BATCH * 2
+        n_stocks = AUDIT_STOCKS
+    split = _synthetic_split(
+        n_windows, rng, n_factors=n_factors, n_stocks=n_stocks
+    )
 
     init_key = jax.random.key(0)
-    dummy = jnp.zeros((1, AUDIT_LOOKBACK, AUDIT_FEATURES), jnp.float32)
+    dummy = jnp.zeros(
+        (1, AUDIT_LOOKBACK, 2 * n_factors + 1), jnp.float32
+    )
     params = module.init(init_key, dummy)["params"]
     opt_state = tx.init(params)
     in_dtypes = [p.dtype for p in jax.tree_util.tree_leaves(params)]
@@ -328,11 +374,22 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
     repl = replicated_sharding(mesh)
     params = global_put(params, repl)
     opt_state = global_put(opt_state, repl)
-    data = global_put(split, batch_sharding(mesh))
+    if shard_axis == "asset":
+        asset_sh = batch_sharding(mesh, batch_dim=1)
+        from masters_thesis_tpu.data.pipeline import Batch
+
+        data = Batch(
+            global_put(split.x, asset_sh),
+            global_put(split.y, asset_sh),
+            global_put(split.factor, repl),
+            global_put(split.inv_psi, asset_sh),
+        )
+    else:
+        data = global_put(split, batch_sharding(mesh))
 
     epoch_fn = make_train_epoch(
         module, objective, spec.metric_keys, tx, mesh,
-        batch_size=AUDIT_BATCH,
+        batch_size=AUDIT_BATCH, shard_axis=shard_axis,
     )
 
     # Every input the measured loop will touch is created and materialized
@@ -384,7 +441,17 @@ def _run_trace_audit(spec, mesh, steps, check_collectives) -> list[Finding]:
                 )
             )
         data_sh = _leaf_shardings(arg_shardings[4])
-        if mesh.size > 1 and any(s.is_fully_replicated for s in data_sh):
+        if shard_axis == "asset":
+            # The factor leaf (index 2: per-window factor stats, no asset
+            # axis) is replicated BY DESIGN; the per-asset leaves must shard.
+            sharded_leaves = [
+                s for i, s in enumerate(data_sh) if i != 2
+            ]
+        else:
+            sharded_leaves = data_sh
+        if mesh.size > 1 and any(
+            s.is_fully_replicated for s in sharded_leaves
+        ):
             findings.append(
                 Finding(
                     rule="TA203",

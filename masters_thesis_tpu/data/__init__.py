@@ -7,7 +7,10 @@ batch iteration (the reference delegates the last to torch DataLoader worker
 processes + pinned memory).
 """
 
-from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.data.synthetic import (
+    SyntheticKFactorReturns,
+    SyntheticLogReturns,
+)
 from masters_thesis_tpu.data.fama_french import FamaFrench25Portfolios
 from masters_thesis_tpu.data.pipeline import (
     Batch,
@@ -16,13 +19,17 @@ from masters_thesis_tpu.data.pipeline import (
     bootstrap_real,
 )
 from masters_thesis_tpu.data.prefetch import prefetch_to_device
+from masters_thesis_tpu.data.window_store import WindowStore, WindowStoreError
 
 __all__ = [
     "SyntheticLogReturns",
+    "SyntheticKFactorReturns",
     "FamaFrench25Portfolios",
     "Batch",
     "FinancialWindowDataModule",
     "bootstrap_synthetic",
     "bootstrap_real",
     "prefetch_to_device",
+    "WindowStore",
+    "WindowStoreError",
 ]

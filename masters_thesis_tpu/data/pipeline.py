@@ -26,7 +26,10 @@ from typing import Iterator, NamedTuple
 import numpy as np
 
 from masters_thesis_tpu.data.fama_french import FamaFrench25Portfolios
-from masters_thesis_tpu.data.synthetic import SyntheticLogReturns
+from masters_thesis_tpu.data.synthetic import (
+    SyntheticKFactorReturns,
+    SyntheticLogReturns,
+)
 from masters_thesis_tpu.utils import (
     atomic_publish,
     atomic_write_text,
@@ -46,13 +49,14 @@ class Batch(NamedTuple):
     Schema matches the reference's TensorDataset columns
     (reference: src/data.py:216): ``x`` carries the feature-expanded lookback
     window, ``y`` the target window with channels
-    ``[r_stock, r_market, alpha, beta]``, plus per-window factor stats and
+    ``[r_stock, f_1..f_F, alpha, beta_1..beta_F]`` (``[r_stock, r_market,
+    alpha, beta]`` in the scalar F=1 case), plus per-window factor stats and
     inverse idiosyncratic variances.
     """
 
     x: np.ndarray  # (B, K, lookback, n_features)
-    y: np.ndarray  # (B, K, target, 4)
-    factor: np.ndarray  # (B, 2) = (market mean, market var)
+    y: np.ndarray  # (B, K, target, 2F+2)
+    factor: np.ndarray  # (B, 2) = (mean, var) at F=1; (B, F+F²) = [mean|cov]
     inv_psi: np.ndarray  # (B, K)
 
 
@@ -63,6 +67,7 @@ def bootstrap_synthetic(
     seed: int = 0,
     variant: str = "no_outliers",
     marker_grace_s: float = 60.0,
+    n_factors: int = 1,
 ) -> None:
     """Generate and save the synthetic market history if not already present.
 
@@ -80,6 +85,10 @@ def bootstrap_synthetic(
         "n_stocks": n_stocks, "n_samples": n_samples, "seed": seed,
         "variant": variant,
     }
+    if n_factors != 1:
+        # Only recorded off the scalar default so existing K=1 datasets (and
+        # their byte-identical dgp.json markers) keep validating unchanged.
+        requested["n_factors"] = n_factors
     meta_file = data_dir / "dgp.json"
 
     def check_existing() -> bool:
@@ -118,13 +127,22 @@ def bootstrap_synthetic(
             return
 
     data_dir.mkdir(parents=True, exist_ok=True)
-    r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
-        n_stocks, n_samples, seed, variant=variant
-    )
-    arrays = {
-        "stocks.npy": r_stocks, "market.npy": r_market,
-        "alphas.npy": alphas, "betas.npy": betas,
-    }
+    if n_factors == 1:
+        r_stocks, r_market, alphas, betas = SyntheticLogReturns.generate(
+            n_stocks, n_samples, seed, variant=variant
+        )
+        arrays = {
+            "stocks.npy": r_stocks, "market.npy": r_market,
+            "alphas.npy": alphas, "betas.npy": betas,
+        }
+    else:
+        r_assets, factors, alphas, betas = SyntheticKFactorReturns.generate(
+            n_stocks, n_samples, n_factors, seed, variant=variant
+        )
+        arrays = {
+            "stocks.npy": r_assets, "factors.npy": factors,
+            "alphas.npy": alphas, "betas.npy": betas,
+        }
     for name, arr in arrays.items():
         # Atomic per-file publish: concurrent same-params writers (parallel
         # sweep jobs sharing a data_dir) never expose a torn .npy.
@@ -168,9 +186,12 @@ class FinancialWindowDataModule:
         interaction_only: bool = True,
         batch_size: int = 1,
         engine: str = "auto",
+        store_shards: int | None = None,
     ):
         if engine not in ("auto", "native", "python"):
             raise ValueError(f"unknown engine: {engine!r}")
+        if store_shards is not None and store_shards < 1:
+            raise ValueError(f"store_shards must be >= 1, got {store_shards}")
         self.data_dir = Path(data_dir)
         self.lookback_window = lookback_window
         self.target_window = target_window
@@ -179,11 +200,13 @@ class FinancialWindowDataModule:
         self.interaction_only = interaction_only
         self.batch_size = batch_size
         self.engine = engine
+        self.store_shards = store_shards
 
         self.train_range: range | None = None
         self.val_range: range | None = None
         self.test_range: range | None = None
         self._arrays: Batch | None = None
+        self._store = None  # WindowStore when store_shards is set
 
         if not prediction_task and target_window > lookback_window:
             raise ValueError(
@@ -193,13 +216,25 @@ class FinancialWindowDataModule:
     # ------------------------------------------------------------------ prep
 
     @property
+    def n_factors(self) -> int:
+        """Factor count of the source series: rows of ``factors.npy`` when
+        the K-factor DGP wrote one, else 1 (scalar market series)."""
+        path = self.data_dir / "factors.npy"
+        if not path.exists():
+            return 1
+        return int(np.load(path, mmap_mode="r").shape[0])
+
+    @property
     def n_features(self) -> int:
-        return 3 if self.interaction_only else 5
+        k = self.n_factors
+        return 2 * k + 1 if self.interaction_only else 3 * k + 2
 
     @property
     def n_stocks(self) -> int | None:
         """Stocks per window (the LSTM kernel's row count), once ``setup``
         has loaded the arrays; None before that."""
+        if getattr(self, "_store", None) is not None:
+            return int(self._store.field_shape("x")[1])
         arrays = getattr(self, "_arrays", None)
         return None if arrays is None else int(arrays.x.shape[1])
 
@@ -234,7 +269,7 @@ class FinancialWindowDataModule:
         byte-identical regeneration doesn't.
         """
         fingerprint: list = []
-        for name in ("stocks.npy", "market.npy", "dgp.json"):
+        for name in ("stocks.npy", "market.npy", "factors.npy", "dgp.json"):
             path = self.data_dir / name
             if path.exists():
                 with open(path, "rb") as f:
@@ -264,6 +299,10 @@ class FinancialWindowDataModule:
         concurrent duplicate build harmless). The hash file is written AFTER
         the dataset, so readers never observe a torn cache.
         """
+        if self.store_shards is not None:
+            self._prepare_store(verbose=verbose, cache_timeout_s=cache_timeout_s)
+            return
+
         hparams_hash = self._hparams_hash()
         self._datasets_dir.mkdir(parents=True, exist_ok=True)
         hash_file = self._datasets_dir / "hparams_hash.txt"
@@ -289,10 +328,7 @@ class FinancialWindowDataModule:
                     "no shared cache appeared; building a host-local one"
                 )
 
-        r_stocks = np.load(self.data_dir / "stocks.npy")
-        r_market = np.load(self.data_dir / "market.npy")
-        alphas = self._load_if_exists("alphas.npy")
-        betas = self._load_if_exists("betas.npy")
+        r_stocks, r_market, alphas, betas = self._load_source()
 
         x, y, t_alphas, t_betas, t_factor, t_inv_psi = self._build_windows(
             r_stocks, r_market, verbose=verbose
@@ -300,24 +336,9 @@ class FinancialWindowDataModule:
 
         # Real data has no ground-truth coefficients; supervise with the
         # target-window OLS fit instead (reference: src/data.py:209-211).
-        if alphas is None or betas is None:
-            alpha_label = np.asarray(t_alphas)
-            beta_label = np.asarray(t_betas)
-        else:
-            n_windows = y.shape[0]
-            alpha_label = np.broadcast_to(alphas[None, :], (n_windows, len(alphas)))
-            beta_label = np.broadcast_to(betas[None, :], (n_windows, len(betas)))
+        from masters_thesis_tpu.data.window_store import append_label_channels
 
-        y = np.concatenate(
-            [
-                np.asarray(y),
-                np.broadcast_to(
-                    alpha_label[:, :, None, None], y.shape[:3] + (1,)
-                ),
-                np.broadcast_to(beta_label[:, :, None, None], y.shape[:3] + (1,)),
-            ],
-            axis=-1,
-        )
+        y = append_label_channels(np.asarray(y), t_alphas, t_betas, alphas, betas)
 
         # Atomic publish (dataset first, then hash): concurrent readers only
         # accept the cache once both files are complete and consistent.
@@ -332,6 +353,77 @@ class FinancialWindowDataModule:
                 )
         atomic_write_text(hash_file, hparams_hash)
 
+    def _load_source(self):
+        """Raw series + ground-truth labels: K-factor block when the DGP
+        wrote ``factors.npy``, else the scalar market series."""
+        r_stocks = np.load(self.data_dir / "stocks.npy")
+        factors = self._load_if_exists("factors.npy")
+        if factors is None:
+            factors = np.load(self.data_dir / "market.npy")
+        alphas = self._load_if_exists("alphas.npy")
+        betas = self._load_if_exists("betas.npy")
+        return r_stocks, factors, alphas, betas
+
+    @property
+    def _store_dir(self) -> Path:
+        return self._datasets_dir / "window_store"
+
+    def _prepare_store(self, verbose: bool, cache_timeout_s: float) -> None:
+        """Build (or accept) the on-disk sharded window store.
+
+        Same multi-host discipline as the npz cache: the manifest is the
+        completion marker, a matching ``source_hash`` (the hparams hash) plus
+        shard count means the store is current, and non-zero ranks poll
+        before falling back to a host-local build.
+        """
+        from masters_thesis_tpu.data.window_store import (
+            WindowStore,
+            WindowStoreError,
+        )
+
+        hparams_hash = self._hparams_hash()
+        n_shards = self.store_shards
+        assert n_shards is not None
+
+        def cache_ready() -> bool:
+            try:
+                store = WindowStore.open(self._store_dir)
+            except WindowStoreError:
+                return False
+            return (
+                store.source_hash == hparams_hash
+                and store.n_shards == min(n_shards, store.n_windows)
+            )
+
+        if cache_ready():
+            if verbose:
+                print("Window store unchanged, skipping data preparation")
+            return
+        rank, world = multihost_rank()
+        if world > 1 and rank != 0:
+            if wait_until(cache_ready, cache_timeout_s):
+                return
+            if verbose:
+                print("no shared window store appeared; building host-local")
+
+        r_stocks, factors, alphas, betas = self._load_source()
+        if self.engine == "native" and verbose:
+            print("window store builds use the jnp path (native engine N/A)")
+        WindowStore.build_from_series(
+            self._store_dir,
+            r_stocks,
+            factors,
+            alphas,
+            betas,
+            lookback_window=self.lookback_window,
+            target_window=self.target_window,
+            stride=self.stride,
+            prediction=self.prediction_task,
+            interaction_only=self.interaction_only,
+            n_shards=n_shards,
+            source_hash=hparams_hash,
+        )
+
     def _build_windows(self, r_stocks, r_market, verbose: bool):
         """Window + feature-expand + OLS-label pass, native engine preferred.
 
@@ -339,7 +431,12 @@ class FinancialWindowDataModule:
         available and falls back to the jnp pipeline otherwise; both paths are
         parity-tested (tests/test_native_engine.py).
         """
-        if self.engine in ("auto", "native"):
+        if np.ndim(r_market) > 1 and self.engine == "native":
+            raise ValueError(
+                "engine='native' only supports the scalar market series; the "
+                "K-factor pipeline uses the jnp path (engine='python'/'auto')"
+            )
+        if self.engine in ("auto", "native") and np.ndim(r_market) == 1:
             from masters_thesis_tpu import native
 
             try:
@@ -380,11 +477,18 @@ class FinancialWindowDataModule:
 
     def setup(self, stage: str | None = None) -> None:
         """Load the cached dataset and compute the chronological 70/20/10 split."""
-        with np.load(self._datasets_dir / "dataset.npz") as data:
-            self._arrays = Batch(
-                x=data["x"], y=data["y"], factor=data["factor"], inv_psi=data["inv_psi"]
-            )
-        n = self._arrays.x.shape[0]
+        if self.store_shards is not None:
+            from masters_thesis_tpu.data.window_store import WindowStore
+
+            self._store = WindowStore.open(self._store_dir)
+            n = self._store.n_windows
+        else:
+            with np.load(self._datasets_dir / "dataset.npz") as data:
+                self._arrays = Batch(
+                    x=data["x"], y=data["y"], factor=data["factor"],
+                    inv_psi=data["inv_psi"],
+                )
+            n = self._arrays.x.shape[0]
         train_end = int(0.7 * n)
         val_end = int(0.9 * n)
         if stage in ("fit", None):
@@ -394,6 +498,10 @@ class FinancialWindowDataModule:
             self.test_range = range(val_end, n)
 
     def _slice(self, idx) -> Batch:
+        if self._store is not None:
+            if isinstance(idx, slice):
+                idx = np.arange(self._store.n_windows)[idx]
+            return Batch(*self._store.take(idx))
         assert self._arrays is not None, "call setup() first"
         a = self._arrays
         return Batch(a.x[idx], a.y[idx], a.factor[idx], a.inv_psi[idx])
@@ -409,13 +517,23 @@ class FinancialWindowDataModule:
         for start in range(0, len(order), batch_size):
             yield self._slice(order[start : start + batch_size])
 
-    def train_batches(self, epoch: int = 0, seed: int = 0) -> Iterator[Batch]:
-        """Shuffled train batches; shuffle order is (seed, epoch)-deterministic."""
+    def train_batches(
+        self, epoch: int = 0, seed: int = 0, shuffle: bool = True
+    ) -> Iterator[Batch]:
+        """Shuffled train batches; shuffle order is (seed, epoch)-deterministic.
+
+        ``shuffle=False`` iterates windows in order — through the window
+        store that keeps every same-shard batch a contiguous zero-copy
+        memmap slice (the streaming-health measurement path; training
+        itself always shuffles).
+        """
         assert self.train_range is not None, "call setup('fit') first"
         # Sequence seed, not hash((seed, epoch)): tuple hashing is a CPython
         # implementation detail and would break cross-version reproducibility.
         return self._iterate(
-            self.train_range, self.batch_size, shuffle_seed=(seed, epoch)
+            self.train_range,
+            self.batch_size,
+            shuffle_seed=(seed, epoch) if shuffle else None,
         )
 
     def val_batches(self) -> Iterator[Batch]:
@@ -444,5 +562,10 @@ class FinancialWindowDataModule:
         if stage == "cleanup":
             (self._datasets_dir / "dataset.npz").unlink(missing_ok=True)
             (self._datasets_dir / "hparams_hash.txt").unlink(missing_ok=True)
+            if self._store_dir.exists():
+                self._store = None
+                for shard_file in self._store_dir.iterdir():
+                    shard_file.unlink()
+                self._store_dir.rmdir()
             if self._datasets_dir.exists():
                 self._datasets_dir.rmdir()

@@ -22,6 +22,7 @@ import time
 from typing import Iterable, Iterator, Any
 
 import jax
+import numpy as np
 
 from masters_thesis_tpu.parallel import global_put
 
@@ -36,6 +37,15 @@ class PrefetchStats:
     depth_sum: int = 0       # queue depth observed at each yield
     min_depth: int | None = None
     exhausted: bool = False  # source ran dry (the tail of every epoch)
+    # Memory-mapped sources (data/window_store.py): a store iterator returns
+    # memmap VIEWS in microseconds and the real I/O happens as page faults
+    # when the bytes are first touched. Without forcing residency here,
+    # those faults land inside the device transfer and the get-wait split
+    # under-reports starvation as "fast producer" + mysteriously slow
+    # dispatch. fault_wait_s is the page-in time (a sub-component of
+    # get_wait_s); mmap_bytes the volume paged through the store.
+    fault_wait_s: float = 0.0
+    mmap_bytes: int = 0
 
     def observe_depth(self, depth: int) -> None:
         self.yields += 1
@@ -56,7 +66,32 @@ class PrefetchStats:
             "mean_depth": self.mean_depth,
             "min_depth": self.min_depth,
             "exhausted": self.exhausted,
+            "fault_wait_s": self.fault_wait_s,
+            "mmap_bytes": self.mmap_bytes,
         }
+
+
+def _materialize_mmap(item, stats: PrefetchStats | None):
+    """Force memmap leaves resident (timed), leaving other leaves untouched.
+
+    ``np.ascontiguousarray`` on a memmap touches every page — the fault wait
+    happens HERE, on the producer side of the double buffer where it can
+    overlap device compute, and is accounted in ``stats.fault_wait_s``
+    instead of hiding inside the device transfer.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(item)
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, np.memmap):
+            t0 = time.perf_counter()
+            forced = np.ascontiguousarray(leaf)
+            if stats is not None:
+                stats.fault_wait_s += time.perf_counter() - t0
+                stats.mmap_bytes += int(leaf.nbytes)
+            out.append(forced)
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def prefetch_to_device(
@@ -103,7 +138,7 @@ def prefetch_to_device(
                 stats.get_wait_s += time.perf_counter() - t0
                 stats.exhausted = True
             return False
-        queue.append(put(item))
+        queue.append(put(_materialize_mmap(item, stats)))
         if stats is not None:
             stats.get_wait_s += time.perf_counter() - t0
             stats.gets += 1

@@ -1,0 +1,371 @@
+"""On-disk sharded window store: memory-mapped, content-hashed, mesh-aligned.
+
+``data/pipeline.py`` materializes every window in host RAM before training —
+fine for 25 portfolios, a wall at universe scale (thousands of assets ×
+``2K+2`` target channels). The store keeps the windowed dataset on disk as
+``n_shards`` independent shard files per field, built shard-by-shard from
+bounded time slices of the raw series, and serves them back as ``np.memmap``
+views so the OS page cache — not the Python heap — owns residency.
+
+Layout decisions mirror the rest of the repo:
+
+- shard boundaries come from :func:`masters_thesis_tpu.parallel.mesh.shard_bounds`
+  (balanced contiguous, remainder to the first ranks) so a shard per mesh
+  rank lines up exactly with the device sharding the trainer will request;
+- every shard file is atomically published and recorded in ``manifest.json``
+  with its byte size and full SHA-256, the same torn/consistency discipline
+  as the dataset cache (``manifest.json`` is written last and is the
+  completion marker);
+- builds go through the *same* jnp window ops as
+  ``FinancialWindowDataModule._build_windows`` — each window's features and
+  OLS labels depend only on that window's own time slice, so a shard built
+  from its slice is bitwise identical to the corresponding rows of an
+  all-in-memory build through the python engine (parity-tested on the
+  8-way mesh layout in tests/test_window_store.py; the NATIVE engine's
+  scalar-path windows differ from the jnp path at the last ulp, which is
+  why store builds always use the jnp path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+import numpy as np
+
+from masters_thesis_tpu.ops import (
+    add_quadratic_features,
+    lookback_target_split,
+    ols_features,
+)
+from masters_thesis_tpu.parallel.mesh import shard_bounds
+from masters_thesis_tpu.utils import atomic_publish, atomic_write_text
+
+MANIFEST_NAME = "manifest.json"
+STORE_VERSION = 1
+
+# Per-window fields, in the order the pipeline's Batch expects them.
+FIELDS = ("x", "y", "factor", "inv_psi")
+
+
+class WindowStoreError(RuntimeError):
+    """Raised when a store is absent, torn, or fails content verification."""
+
+
+def _shard_filename(shard: int, field: str) -> str:
+    return f"shard{shard:05d}.{field}.npy"
+
+
+def _sha256_file(path: Path) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+class WindowStore:
+    """Reader over a built store directory; shards are served as memmaps."""
+
+    def __init__(self, store_dir: Path, manifest: dict):
+        self.store_dir = Path(store_dir)
+        self.manifest = manifest
+        self._shard_cache: dict[int, dict[str, np.ndarray]] = {}
+
+    # ---------------------------------------------------------------- opening
+
+    @classmethod
+    def open(cls, store_dir: Path, verify: bool = False) -> "WindowStore":
+        """Open a store, refusing anything torn or (with ``verify``) altered.
+
+        Structural checks always run: the manifest must exist (it is written
+        last, so its absence means an unfinished or absent build) and every
+        recorded shard file must exist with exactly its recorded byte size.
+        ``verify=True`` additionally re-hashes every shard file against the
+        manifest SHA-256 — the slow path for provenance disputes and the
+        corrupt-shard runbook (docs/OPERATIONS.md).
+        """
+        store_dir = Path(store_dir)
+        manifest_file = store_dir / MANIFEST_NAME
+        if not manifest_file.exists():
+            raise WindowStoreError(
+                f"{store_dir} has no {MANIFEST_NAME} — the store is absent or "
+                "a build was torn before completion; rebuild it"
+            )
+        manifest = json.loads(manifest_file.read_text())
+        if manifest.get("version") != STORE_VERSION:
+            raise WindowStoreError(
+                f"{store_dir} manifest version {manifest.get('version')!r} != "
+                f"{STORE_VERSION} — rebuild the store"
+            )
+        for entry in manifest["shards"]:
+            for field, rec in entry["files"].items():
+                path = store_dir / _shard_filename(entry["shard"], field)
+                if not path.exists():
+                    raise WindowStoreError(
+                        f"{path.name} is missing from {store_dir} (torn "
+                        "store) — rebuild the store"
+                    )
+                size = path.stat().st_size
+                if size != rec["bytes"]:
+                    raise WindowStoreError(
+                        f"{path.name} is {size} bytes, manifest records "
+                        f"{rec['bytes']} (torn or truncated shard) — rebuild "
+                        "the store"
+                    )
+                if verify and _sha256_file(path) != rec["sha256"]:
+                    raise WindowStoreError(
+                        f"{path.name} content hash does not match the "
+                        "manifest — the shard was altered or corrupted after "
+                        "publish; rebuild the store"
+                    )
+        return cls(store_dir, manifest)
+
+    # ------------------------------------------------------------- properties
+
+    @property
+    def n_windows(self) -> int:
+        return int(self.manifest["n_windows"])
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.manifest["n_shards"])
+
+    @property
+    def source_hash(self) -> str:
+        return self.manifest.get("source_hash", "")
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            rec["bytes"]
+            for entry in self.manifest["shards"]
+            for rec in entry["files"].values()
+        )
+
+    def field_shape(self, field: str) -> tuple[int, ...]:
+        """Global (all-windows) shape of one field."""
+        return (self.n_windows, *self.manifest["fields"][field]["shape"])
+
+    def bounds(self, shard: int) -> tuple[int, int]:
+        entry = self.manifest["shards"][shard]
+        return int(entry["lo"]), int(entry["hi"])
+
+    # ---------------------------------------------------------------- reading
+
+    def load_shard(self, shard: int) -> dict[str, np.ndarray]:
+        """Memory-mapped views of one shard's fields (windows ``[lo, hi)``)."""
+        cached = self._shard_cache.get(shard)
+        if cached is not None:
+            return cached
+        arrays = {
+            field: np.load(
+                self.store_dir / _shard_filename(shard, field), mmap_mode="r"
+            )
+            for field in FIELDS
+        }
+        self._shard_cache[shard] = arrays
+        return arrays
+
+    def _shard_of(self, indices: np.ndarray) -> np.ndarray:
+        los = np.asarray([e["lo"] for e in self.manifest["shards"]])
+        return np.searchsorted(los, indices, side="right") - 1
+
+    def take(self, indices) -> tuple[np.ndarray, ...]:
+        """Rows ``indices`` of every field, in FIELDS order.
+
+        A contiguous ascending run inside one shard comes back as zero-copy
+        memmap views (the hot path: sequential batches through the prefetcher);
+        anything else is gathered shard-by-shard into fresh arrays.
+        """
+        idx = np.asarray(indices, dtype=np.int64)
+        if idx.ndim != 1:
+            raise ValueError(f"take() wants a 1-D index array, got {idx.shape}")
+        if idx.size == 0:
+            return tuple(
+                np.empty((0, *self.manifest["fields"][f]["shape"]),
+                         dtype=self.manifest["fields"][f]["dtype"])
+                for f in FIELDS
+            )
+        shards = self._shard_of(idx)
+        same_shard = bool((shards == shards[0]).all())
+        contiguous = idx.size == 1 or bool((np.diff(idx) == 1).all())
+        if same_shard and contiguous:
+            lo, _ = self.bounds(int(shards[0]))
+            arrays = self.load_shard(int(shards[0]))
+            a, b = int(idx[0] - lo), int(idx[-1] - lo + 1)
+            return tuple(arrays[f][a:b] for f in FIELDS)
+        out = tuple(
+            np.empty((idx.size, *self.manifest["fields"][f]["shape"]),
+                     dtype=self.manifest["fields"][f]["dtype"])
+            for f in FIELDS
+        )
+        for shard in np.unique(shards):
+            mask = shards == shard
+            s_lo, _ = self.bounds(int(shard))
+            arrays = self.load_shard(int(shard))
+            rel = idx[mask] - s_lo
+            for field, dst in zip(FIELDS, out):
+                dst[mask] = arrays[field][rel]
+        return out
+
+    def load_all(self) -> tuple[np.ndarray, ...]:
+        """Every window of every field, concatenated (copies — test-sized use)."""
+        return self.take(np.arange(self.n_windows))
+
+    def iter_shards(self):
+        """Yield ``(lo, hi, {field: memmap})`` per shard, in layout order."""
+        for shard in range(self.n_shards):
+            lo, hi = self.bounds(shard)
+            yield lo, hi, self.load_shard(shard)
+
+    # --------------------------------------------------------------- building
+
+    @classmethod
+    def build_from_series(
+        cls,
+        store_dir: Path,
+        r_stocks: np.ndarray,
+        r_factors: np.ndarray,
+        alphas: np.ndarray | None = None,
+        betas: np.ndarray | None = None,
+        *,
+        lookback_window: int,
+        target_window: int,
+        stride: int,
+        prediction: bool = True,
+        interaction_only: bool = True,
+        n_shards: int,
+        source_hash: str = "",
+        telemetry=None,
+    ) -> "WindowStore":
+        """Build a store shard-by-shard from the raw return series.
+
+        Each shard is computed from the minimal time slice covering its
+        windows and runs the exact jnp window/feature/OLS-label ops the
+        in-memory pipeline uses, so rows are bitwise identical to a full
+        ``_build_windows`` pass. Ground-truth ``alphas``/``betas`` (synthetic
+        data) become the labels; without them the per-window OLS fit is the
+        label, matching ``prepare_data``.
+        """
+        store_dir = Path(store_dir)
+        store_dir.mkdir(parents=True, exist_ok=True)
+        total_window = (
+            lookback_window + target_window if prediction else lookback_window
+        )
+        n_samples = r_stocks.shape[1]
+        n_windows = (n_samples - total_window) // stride + 1
+        if n_windows < n_shards:
+            n_shards = max(1, n_windows)
+
+        shard_entries = []
+        fields_meta: dict[str, dict] = {}
+        for shard in range(n_shards):
+            lo, hi = shard_bounds(n_windows, n_shards, shard)
+            t0 = lo * stride
+            t1 = (hi - 1) * stride + total_window
+            factors_slice = (
+                r_factors[t0:t1]
+                if r_factors.ndim == 1
+                else r_factors[:, t0:t1]
+            )
+            x, y = lookback_target_split(
+                r_stocks[:, t0:t1],
+                factors_slice,
+                lookback_window=lookback_window,
+                target_window=target_window,
+                stride=stride,
+                prediction=prediction,
+            )
+            x = add_quadratic_features(x, interaction_only=interaction_only)
+            t_alphas, t_betas, t_factor, t_inv_psi = ols_features(y)
+            y = append_label_channels(
+                np.asarray(y), t_alphas, t_betas, alphas, betas
+            )
+            arrays = {
+                "x": np.asarray(x),
+                "y": y,
+                "factor": np.asarray(t_factor),
+                "inv_psi": np.asarray(t_inv_psi),
+            }
+            files = {}
+            for field, arr in arrays.items():
+                path = store_dir / _shard_filename(shard, field)
+                with atomic_publish(path) as tmp:
+                    with open(tmp, "wb") as f:
+                        np.save(f, arr)
+                    files[field] = {
+                        "sha256": _sha256_file(Path(tmp)),
+                        "bytes": Path(tmp).stat().st_size,
+                    }
+                if field not in fields_meta:
+                    fields_meta[field] = {
+                        "shape": list(arr.shape[1:]),
+                        "dtype": str(arr.dtype),
+                    }
+            shard_entries.append(
+                {"shard": shard, "lo": lo, "hi": hi, "files": files}
+            )
+
+        manifest = {
+            "version": STORE_VERSION,
+            "n_windows": n_windows,
+            "n_shards": n_shards,
+            "source_hash": source_hash,
+            "fields": fields_meta,
+            "shards": shard_entries,
+        }
+        # Manifest last: it is the completion marker, so readers never see a
+        # half-built store as valid.
+        atomic_write_text(
+            store_dir / MANIFEST_NAME, json.dumps(manifest, indent=2)
+        )
+        if telemetry is not None:
+            telemetry.event(
+                "window_store",
+                action="build",
+                shards=n_shards,
+                windows=n_windows,
+                bytes=sum(
+                    rec["bytes"]
+                    for entry in shard_entries
+                    for rec in entry["files"].values()
+                ),
+            )
+        return cls(store_dir, manifest)
+
+
+def append_label_channels(
+    y: np.ndarray,
+    t_alphas,
+    t_betas,
+    alphas: np.ndarray | None,
+    betas: np.ndarray | None,
+) -> np.ndarray:
+    """Append ``[alpha, beta_1..beta_F]`` label channels to the target window.
+
+    Same semantics as ``FinancialWindowDataModule.prepare_data``: ground-truth
+    coefficients when the DGP recorded them, otherwise the target-window OLS
+    fit. ``betas`` may be ``(n_stocks,)`` (scalar path) or ``(n_stocks, F)``.
+    """
+    n_windows = y.shape[0]
+    if alphas is None or betas is None:
+        alpha_label = np.asarray(t_alphas)
+        beta_label = np.asarray(t_betas)
+    else:
+        alpha_label = np.broadcast_to(alphas[None, :], (n_windows, len(alphas)))
+        beta_label = np.broadcast_to(betas[None], (n_windows,) + betas.shape)
+    if beta_label.ndim == 2:
+        beta_label = beta_label[..., None]  # scalar loading -> one channel
+    return np.concatenate(
+        [
+            y,
+            np.broadcast_to(alpha_label[:, :, None, None], y.shape[:3] + (1,)),
+            np.broadcast_to(
+                beta_label[:, :, None, :],
+                y.shape[:3] + (beta_label.shape[-1],),
+            ),
+        ],
+        axis=-1,
+    )

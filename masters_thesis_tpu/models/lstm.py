@@ -63,6 +63,10 @@ class LstmEncoder(nn.Module):
     hidden_size: int = 64
     num_layers: int = 2
     dropout: float = 0.2
+    # Loadings per row: the beta head emits one coefficient per factor. The
+    # default keeps the scalar (alpha, beta) head — parameter shapes, names,
+    # and init draws are unchanged at n_factors=1.
+    n_factors: int = 1
     compute_dtype: Any = jnp.float32
     kernel_impl: str = "auto"  # pallas | xla | interpret | auto
     # Rematerialize each layer's recurrence in the backward pass: the
@@ -100,7 +104,8 @@ class LstmEncoder(nn.Module):
                 section).
 
         Returns:
-            ``(alpha, beta)``, each ``(batch, 1)`` float32.
+            ``(alpha, beta)``: ``(batch, 1)`` and ``(batch, n_factors)``
+            float32.
         """
         hidden = self.hidden_size
         scale = 1.0 / math.sqrt(hidden)
@@ -260,6 +265,9 @@ class LstmEncoder(nn.Module):
             1, kernel_init=head_init, bias_init=head_init, name="alpha_head"
         )(final_hidden)
         beta = nn.Dense(
-            1, kernel_init=head_init, bias_init=head_init, name="beta_head"
+            self.n_factors,
+            kernel_init=head_init,
+            bias_init=head_init,
+            name="beta_head",
         )(final_hidden)
         return alpha, beta

@@ -16,8 +16,11 @@ the jitted train step, so the objective choice is fused into one XLA program
 train step").
 
 Batch window schema (see masters_thesis_tpu.data.pipeline.Batch):
-``y``: (K, T, 4) channels [r_stock, r_market, alpha, beta];
-``factor``: (2,) = (market mean, market var); ``inv_psi``: (K,).
+``y``: (K, T, 2F+2) channels [r_stock, f_1..f_F, alpha, beta_1..beta_F]
+((K, T, 4) in the scalar F=1 case); ``factor``: (2,) = (market mean, market
+var) at F=1, (F+F²,) = [f_mean | f_cov.ravel()] otherwise; ``inv_psi``: (K,).
+The factor count is read statically from ``beta.shape[-1]``, and the F=1
+branch is the *original* scalar code, so scalar training is bit-identical.
 """
 
 from __future__ import annotations
@@ -30,6 +33,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from masters_thesis_tpu.ops import (
+    kfactor_gaussian_nll,
     mean_squared_error,
     single_factor_gaussian_nll,
 )
@@ -43,11 +47,18 @@ WindowObjective = Callable[..., tuple[Array, dict[str, tuple[Array, Array]]]]
 def mse_window(
     alpha: Array, beta: Array, y: Array, factor: Array, inv_psi: Array
 ) -> tuple[Array, dict]:
-    """MSE of ``alpha + beta * r_market`` vs realized returns over the target
+    """MSE of ``alpha + beta · factors`` vs realized returns over the target
     window (reference: src/model.py:192-202)."""
     r_target = y[:, :, 0]
-    r_market = y[:, :, 1]
-    r_pred = alpha + beta * r_market  # (K,1) broadcast over (K,T)
+    n_f = beta.shape[-1]
+    if n_f == 1:
+        r_market = y[:, :, 1]
+        r_pred = alpha + beta * r_market  # (K,1) broadcast over (K,T)
+    else:
+        factors = y[:, :, 1 : 1 + n_f]  # (K, T, F)
+        r_pred = alpha + jnp.einsum(
+            "kf,ktf->kt", beta, factors, precision="highest"
+        )
     loss = mean_squared_error(r_pred, r_target)
     n = jnp.float32(r_target.size)
     return loss, {"mse": (loss * n, n)}
@@ -59,11 +70,23 @@ def nll_window(
     """Multivariate-Gaussian NLL with single-factor Woodbury inverse
     covariance (reference: src/model.py:234-249), computed via the fused
     O(K·n) form (ops/losses.py single_factor_gaussian_nll) instead of
-    materializing the K×K inverse covariance."""
+    materializing the K×K inverse covariance. With F>1 loadings the rank-F
+    Woodbury form (ops/losses.py kfactor_gaussian_nll) takes over."""
     r_target = y[:, :, 0]
-    f_mean, f_var = factor[0], factor[1]
-    r_mean = alpha + beta * f_mean  # (K, 1)
-    loss = single_factor_gaussian_nll(r_mean, beta, inv_psi, f_var, r_target)
+    n_f = beta.shape[-1]
+    if n_f == 1:
+        f_mean, f_var = factor[0], factor[1]
+        r_mean = alpha + beta * f_mean  # (K, 1)
+        loss = single_factor_gaussian_nll(
+            r_mean, beta, inv_psi, f_var, r_target
+        )
+    else:
+        f_mean = factor[:n_f]  # (F,)
+        f_cov = factor[n_f:].reshape(n_f, n_f)
+        r_mean = alpha + jnp.matmul(
+            beta, f_mean[:, None], precision="highest"
+        )  # (K, 1)
+        loss = kfactor_gaussian_nll(r_mean, beta, inv_psi, f_cov, r_target)
     return loss, {"nll": (loss, jnp.float32(1.0))}
 
 
@@ -137,6 +160,7 @@ class ModelSpec:
     hidden_size: int = 64
     num_layers: int = 2
     dropout: float = 0.2
+    n_factors: int = 1  # loadings per row (beta head width)
     learning_rate: float = 1e-4
     weight_decay: float = 1e-5
     mse_weight: float = 1e2
@@ -150,6 +174,7 @@ class ModelSpec:
             hidden_size=self.hidden_size,
             num_layers=self.num_layers,
             dropout=self.dropout,
+            n_factors=self.n_factors,
             compute_dtype=compute_dtype,
             kernel_impl=self.kernel_impl,
             remat=self.remat,

@@ -6,7 +6,7 @@ Every function here is traceable under ``jax.jit`` and free of Python-level
 data-dependent control flow, so XLA can fuse it into the surrounding step.
 """
 
-from masters_thesis_tpu.ops.linalg import ols, inverse_returns_covariance
+from masters_thesis_tpu.ops.linalg import ols, ols_k, inverse_returns_covariance
 from masters_thesis_tpu.ops.windows import (
     lookback_target_split,
     add_quadratic_features,
@@ -15,18 +15,21 @@ from masters_thesis_tpu.ops.windows import (
 from masters_thesis_tpu.ops.losses import (
     multivariate_gaussian_nll,
     single_factor_gaussian_nll,
+    kfactor_gaussian_nll,
     mean_squared_error,
     LOG_2PI,
 )
 
 __all__ = [
     "ols",
+    "ols_k",
     "inverse_returns_covariance",
     "lookback_target_split",
     "add_quadratic_features",
     "ols_features",
     "multivariate_gaussian_nll",
     "single_factor_gaussian_nll",
+    "kfactor_gaussian_nll",
     "mean_squared_error",
     "LOG_2PI",
 ]

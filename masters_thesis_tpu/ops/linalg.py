@@ -50,6 +50,42 @@ def _batched_ols(x: Array, y: Array) -> tuple[Array, Array]:
     return coef[:, 0, :], coef[:, 1, :]
 
 
+def ols_k(factors: Array, y: Array) -> tuple[Array, Array]:
+    """Multi-factor least squares: ``y ≈ alpha + factors @ beta``, batched.
+
+    The K-factor generalization of :func:`ols`: the design matrix is
+    ``[1 | f_1 ... f_F]`` so the solved coefficient vector is ``[K+1]``
+    per stock (intercept + one loading per factor). At ``F == 1`` the
+    design matrix holds exactly the values :func:`_batched_ols` stacks, so
+    the result is bit-identical to the scalar path (the parity anchor —
+    tests/test_ops_linalg.py).
+
+    Args:
+        factors: factor return series — ``(n_samples, F)`` or
+            ``(batch, n_samples, F)``.
+        y: regressand series — ``(n_stocks, n_samples)`` or
+            ``(batch, n_stocks, n_samples)``.
+
+    Returns:
+        ``(alphas, betas)`` with shapes ``(..., n_stocks)`` and
+        ``(..., n_stocks, F)``.
+    """
+    if factors.ndim == 2 and y.ndim == 2:
+        alphas, betas = _batched_ols_k(factors[None], y[None])
+        return alphas[0], betas[0]
+    return _batched_ols_k(factors, y)
+
+
+def _batched_ols_k(factors: Array, y: Array) -> tuple[Array, Array]:
+    """factors: (batch, n, F); y: (batch, k, n)."""
+    ones = jnp.ones(factors.shape[:-1] + (1,), factors.dtype)
+    design = jnp.concatenate([ones, factors], axis=-1)  # (batch, n, F+1)
+    gram = jnp.matmul(design.mT, design, precision="highest")
+    moment = jnp.matmul(design.mT, y.mT, precision="highest")  # (b, F+1, k)
+    coef = jnp.matmul(jnp.linalg.pinv(gram), moment, precision="highest")
+    return coef[:, 0, :], jnp.swapaxes(coef[:, 1:, :], -1, -2)
+
+
 def inverse_returns_covariance(
     beta: Array, inv_psi: Array, f_var: Array
 ) -> Array:

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import Array
 
-from masters_thesis_tpu.ops.linalg import ols
+from masters_thesis_tpu.ops.linalg import ols, ols_k
 
 
 def lookback_target_split(
@@ -30,7 +30,9 @@ def lookback_target_split(
 
     Args:
         r_stocks: ``(n_stocks, n_samples)`` stock return series.
-        r_market: ``(n_samples,)`` market return series (broadcast to stocks).
+        r_market: ``(n_samples,)`` market return series (broadcast to
+            stocks), or ``(n_factors, n_samples)`` factor return series for
+            the K-factor workload (each factor becomes one channel).
         lookback_window: encoder context length.
         target_window: supervision horizon length.
         stride: window start spacing; defaults to ``lookback + target``
@@ -40,9 +42,10 @@ def lookback_target_split(
             the trailing ``target_window`` steps *inside* the lookback.
 
     Returns:
-        ``X``: ``(n_windows, n_stocks, lookback_window, 2)`` and
-        ``y``: ``(n_windows, n_stocks, target_window or lookback_window, 2)``
-        with channels ``[r_stock, r_market]``.
+        ``X``: ``(n_windows, n_stocks, lookback_window, 1+n_factors)`` and
+        ``y``: ``(n_windows, n_stocks, target_window or lookback_window,
+        1+n_factors)`` with channels ``[r_stock, f_1 .. f_F]`` (F = 1 for
+        the scalar market series).
     """
     if stride is None:
         stride = lookback_window + target_window
@@ -55,7 +58,18 @@ def lookback_target_split(
 
     total_window = lookback_window + target_window if prediction else lookback_window
 
-    stacked = jnp.stack(jnp.broadcast_arrays(r_stocks, r_market), axis=-1)
+    if r_market.ndim == 1:
+        # Scalar market series: the original two-channel stack, untouched
+        # (the K=1 bit-identity anchor).
+        stacked = jnp.stack(jnp.broadcast_arrays(r_stocks, r_market), axis=-1)
+    else:
+        # (F, T) factor block: broadcast each factor across the asset axis
+        # as its own trailing channel, [r_stock, f_1 .. f_F].
+        factors = jnp.broadcast_to(
+            r_market.T[None, :, :],
+            (r_stocks.shape[0],) + r_market.T.shape,
+        )
+        stacked = jnp.concatenate([r_stocks[..., None], factors], axis=-1)
     n_samples = stacked.shape[1]
     n_windows = (n_samples - total_window) // stride + 1
     if n_windows < 1:
@@ -81,23 +95,26 @@ def lookback_target_split(
 def add_quadratic_features(
     x: Array, interaction_only: bool = False, include_bias: bool = False
 ) -> Array:
-    """Expand the 2-channel window into polynomial features.
+    """Expand the ``1+F``-channel window into polynomial features.
 
-    Produces ``[r_stock, r_market, r_stock*r_market]`` plus the squares when
-    not ``interaction_only``, plus an optional all-ones bias channel
-    (reference: src/common.py:115-130).
+    Produces ``[r_stock, f_1..f_F, r_stock*f_1 .. r_stock*f_F]`` plus the
+    squares (``r_stock², f_1² .. f_F²``) when not ``interaction_only``, plus
+    an optional all-ones bias channel (reference: src/common.py:115-130). At
+    F=1 this is exactly the original ``[r_stock, r_market, r_stock*r_market]``
+    ordering, elementwise op for op, so the scalar path is bit-identical.
 
     Args:
-        x: ``(n_windows, n_stocks, window, 2)``.
+        x: ``(n_windows, n_stocks, window, 1+F)``.
 
     Returns:
-        ``(n_windows, n_stocks, window, n_features)`` with 3..6 features.
+        ``(n_windows, n_stocks, window, n_features)`` with ``2F+1`` features
+        (interaction-only) or ``3F+2``, plus the optional bias.
     """
     r_stock = x[..., 0]
-    r_market = x[..., 1]
-    features = [r_stock, r_market, r_stock * r_market]
+    factors = [x[..., 1 + i] for i in range(x.shape[-1] - 1)]
+    features = [r_stock, *factors, *[r_stock * f for f in factors]]
     if not interaction_only:
-        features.extend([r_stock * r_stock, r_market * r_market])
+        features.extend([r_stock * r_stock, *[f * f for f in factors]])
     if include_bias:
         features.append(jnp.ones_like(r_stock))
     return jnp.stack(features, axis=-1)
@@ -113,26 +130,57 @@ def ols_features(target: Array) -> tuple[Array, Array, Array, Array]:
 
     Variances are unbiased (ddof=1), matching torch's default ``var``.
 
+    With ``F > 1`` factor channels the fit is the multi-factor regression
+    ``r_stock ≈ alpha + Σ_f beta_f * f`` and the factor summary becomes the
+    sample mean vector plus the flattened (ddof=1) factor covariance. The
+    F=1 branch keeps the original scalar code path, op for op, so the K=1
+    pipeline stays bit-identical.
+
     Args:
-        target: ``(n_windows, n_stocks, target_window, >=2)`` with channels
-            ``[r_stock, r_market, ...]``.
+        target: ``(n_windows, n_stocks, target_window, 1+F)`` with channels
+            ``[r_stock, f_1 .. f_F]``.
 
     Returns:
         ``alphas``: ``(n_windows, n_stocks)``,
-        ``betas``: ``(n_windows, n_stocks)``,
-        ``factor``: ``(n_windows, 2)`` = (market mean, market var),
+        ``betas``: ``(n_windows, n_stocks)`` at F=1, else
+        ``(n_windows, n_stocks, F)``,
+        ``factor``: ``(n_windows, 2)`` = (market mean, market var) at F=1,
+        else ``(n_windows, F + F²)`` = ``[f_mean | f_cov.ravel()]``,
         ``inv_psi``: ``(n_windows, n_stocks)`` = 1 / var(residuals).
     """
+    n_f = target.shape[-1] - 1
     r_stocks = target[:, :, :, 0]  # (n_win, n_stocks, tw)
-    r_market = target[:, 0, :, 1]  # (n_win, tw) — market identical across stocks
+    if n_f == 1:
+        r_market = target[:, 0, :, 1]  # (n_win, tw) — market identical across stocks
 
-    alphas, betas = ols(r_market, r_stocks)
+        alphas, betas = ols(r_market, r_stocks)
 
-    r_pred = alphas[..., None] + betas[..., None] * r_market[:, None, :]
+        r_pred = alphas[..., None] + betas[..., None] * r_market[:, None, :]
+        residuals = r_stocks - r_pred
+
+        factor = jnp.stack(
+            [r_market.mean(axis=-1), r_market.var(axis=-1, ddof=1)], axis=-1
+        )
+        psi = residuals.var(axis=-1, ddof=1)
+        inv_psi = 1.0 / psi
+        return alphas, betas, factor, inv_psi
+
+    f = target[:, 0, :, 1:]  # (n_win, tw, F) — factors identical across stocks
+
+    alphas, betas = ols_k(f, r_stocks)  # (n_win, k), (n_win, k, F)
+
+    r_pred = alphas[..., None] + jnp.einsum(
+        "wkf,wtf->wkt", betas, f, precision="highest"
+    )
     residuals = r_stocks - r_pred
 
-    factor = jnp.stack(
-        [r_market.mean(axis=-1), r_market.var(axis=-1, ddof=1)], axis=-1
+    f_mean = f.mean(axis=1)  # (n_win, F)
+    centered = f - f_mean[:, None, :]
+    f_cov = jnp.einsum(
+        "wtf,wtg->wfg", centered, centered, precision="highest"
+    ) / (f.shape[1] - 1)
+    factor = jnp.concatenate(
+        [f_mean, f_cov.reshape(f_cov.shape[0], -1)], axis=-1
     )
     psi = residuals.var(axis=-1, ddof=1)
     inv_psi = 1.0 / psi

@@ -139,6 +139,23 @@ def summarize_events(events: list[dict]) -> dict:
         started.get("n_devices"),
     )
 
+    # Window-store epochs (memory-mapped shards): how many bytes paged
+    # through the store and how much of the data wait was page-fault wait —
+    # the "slow disk vs slow producer" split for universe-scale runs.
+    ws_events = by_kind.get("window_store", [])
+    window_store = None
+    if ws_events:
+        ws_bytes = sum(e.get("bytes_read") or 0 for e in ws_events)
+        ws_fault = sum(e.get("fault_wait_s") or 0.0 for e in ws_events)
+        window_store = {
+            "epochs": len(ws_events),
+            "bytes_read": ws_bytes,
+            "fault_wait_s": ws_fault,
+            "fault_share_pct": (
+                100.0 * ws_fault / data_wait_s if data_wait_s > 0 else 0.0
+            ),
+        }
+
     preflight = (by_kind.get("preflight") or [{}])[-1]
     # Gradient-sync footprint (flat update path, train/flatparams.py): the
     # trainer records one grad_sync event per run — collectives per step
@@ -183,6 +200,7 @@ def summarize_events(events: list[dict]) -> dict:
             "data_wait_s": data_wait_s,
             "starvation_pct": starvation_pct,
         },
+        "window_store": window_store,
         "memory": {
             "peak_bytes": peak,
             "peak_bytes_in_use": peak_bytes,
@@ -672,6 +690,15 @@ def render_text(report: dict) -> str:
         f" | total {t['total']:.2f}",
         f"input pipeline : data-wait {report['data']['data_wait_s']:.3f}s, "
         f"starvation {report['data']['starvation_pct']:.1f}%",
+        *(
+            [
+                f"window store   : {_fmt_bytes(ws['bytes_read'])} paged over "
+                f"{ws['epochs']} epoch(s), fault-wait {ws['fault_wait_s']:.3f}s"
+                f" ({ws['fault_share_pct']:.1f}% of data-wait)"
+            ]
+            if (ws := report.get("window_store"))
+            else []
+        ),
         f"device memory  : peak {_fmt_bytes(mem['peak_bytes'])} "
         f"(live buffers {_fmt_bytes(mem['live_buffer_bytes'])}, "
         f"source: {mem['source'] or 'n/a'})",

@@ -60,7 +60,8 @@ def jit_cache_size(fn) -> int | None:
 
 
 def forward_rows(module, params, x, dropout_rng=None):
-    """Apply the encoder to a window batch: ``(B, K, T, F) -> (B, K, 1)`` x2.
+    """Apply the encoder to a window batch: ``(B, K, T, F) -> (B, K, 1)``
+    alpha and ``(B, K, n_factors)`` beta.
 
     Flattens (batch, stocks) into rows exactly like the reference's
     ``flatten(0, 1)`` step preamble (reference: src/model.py:120-123).
@@ -80,7 +81,7 @@ def forward_rows(module, params, x, dropout_rng=None):
         {"params": params}, rows, deterministic=deterministic, rngs=rngs,
         window_rows=k,
     )
-    return alpha.reshape(b, k, 1), beta.reshape(b, k, 1)
+    return alpha.reshape(b, k, 1), beta.reshape(b, k, -1)
 
 
 def _accumulate(sums: dict, new: dict) -> dict:
@@ -110,12 +111,33 @@ def _make_loss_fn(module, window_objective: WindowObjective):
     return loss_fn
 
 
+def _epoch_rngs(rng, shard_axis: str):
+    """Per-device (shuffle, dropout) rngs for one epoch.
+
+    ``window`` sharding: each device owns a disjoint window shard, so the
+    whole stream is device-folded (independent local shuffles). ``asset``
+    sharding: every device sees ALL windows (only the asset rows differ), so
+    the shuffle MUST be common across devices — folding it would make
+    devices gather different windows into the "same" batch and silently
+    train on torn batches. Only the dropout stream is device-folded there.
+    """
+    if shard_axis == "asset":
+        shuffle_rng, dropout_rng = jax.random.split(rng)
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, lax.axis_index(DATA_AXIS)
+        )
+        return shuffle_rng, dropout_rng
+    rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+    return jax.random.split(rng)
+
+
 def _flat_epoch_body(
     loss_fn,
     tx,
     spec,
     metric_keys: tuple,
     batch_size: int,
+    shard_axis: str = "window",
 ) -> Callable:
     """Shard-local one-epoch body over FLAT buffers, shared by the single
     and stacked paths.
@@ -127,11 +149,20 @@ def _flat_epoch_body(
     elementwise/optimizer ops is per-lane bit-identical, and the batched
     ``lax.pmean`` still lowers to one all-reduce per dtype buffer (TA206,
     and TA207 for the stacked program).
+
+    ``shard_axis='asset'`` (universe-scale workloads): the device shard is a
+    block of asset ROWS instead of a block of windows. Locally nothing
+    changes — batches still gather along axis 0 — but the epoch shuffle
+    stays common across devices (see :func:`_epoch_rngs`) and the objective
+    is computed per asset block (exact for MSE/MAE; the NLL couples assets
+    within a window, so the sharded objective is its block-diagonal form —
+    equal-sized blocks keep the pmean'd gradient the true gradient of that
+    sharded objective). Still exactly ONE pmean per dtype buffer per step,
+    so TA206/TA207 hold verbatim.
     """
 
     def body(pbufs, opt_state, lr, rng, data: Batch):
-        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
-        shuffle_rng, dropout_rng = jax.random.split(rng)
+        shuffle_rng, dropout_rng = _epoch_rngs(rng, shard_axis)
         n_local = data.x.shape[0]
         n_steps = n_local // batch_size
         perm = jax.random.permutation(shuffle_rng, n_local)
@@ -171,6 +202,21 @@ def _flat_epoch_body(
     return body
 
 
+def epoch_data_spec(shard_axis: str) -> Batch:
+    """Partition specs for the train split under either shard axis.
+
+    ``window``: every leaf sharded on its leading window axis. ``asset``:
+    the per-asset leaves (x, y, inv_psi) shard on their asset axis (axis 1)
+    and the per-window ``factor`` stats — which have no asset axis — stay
+    replicated.
+    """
+    if shard_axis == "asset":
+        return Batch(
+            P(None, DATA_AXIS), P(None, DATA_AXIS), P(), P(None, DATA_AXIS)
+        )
+    return Batch(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+
+
 def make_train_epoch(
     module,
     window_objective: WindowObjective,
@@ -178,6 +224,7 @@ def make_train_epoch(
     tx,
     mesh: Mesh,
     batch_size: int = 1,
+    shard_axis: str = "window",
 ) -> Callable:
     """Build the one-epoch program.
 
@@ -192,8 +239,16 @@ def make_train_epoch(
     shuffling stays shard-local so the gather never crosses ICI, and no
     per-epoch index upload crosses the host↔device link (that round-trip was
     ~30% of wall time on a remote-relay TPU).
+
+    ``shard_axis='asset'`` shards the ASSET axis over the mesh instead: each
+    device trains the full window stream over its block of asset rows, which
+    is how a universe-scale cross-section (thousands of rows per window)
+    fills the per-device batch — and the MXU — without replicating the whole
+    cross-section into every device's HBM (see _flat_epoch_body).
     """
 
+    if shard_axis not in ("window", "asset"):
+        raise ValueError(f"unknown shard_axis: {shard_axis!r}")
     loss_fn = _make_loss_fn(module, window_objective)
     flat = isinstance(tx, FlatAdam)
 
@@ -204,7 +259,10 @@ def make_train_epoch(
             # are pure layout ops XLA folds into the neighbouring
             # computation. The body is shared with the stacked path.
             spec = flatten_spec(params)
-            body = _flat_epoch_body(loss_fn, tx, spec, metric_keys, batch_size)
+            body = _flat_epoch_body(
+                loss_fn, tx, spec, metric_keys, batch_size,
+                shard_axis=shard_axis,
+            )
             pbufs, opt_state, sums = body(
                 flatten(params, spec), opt_state, lr, rng, data
             )
@@ -212,8 +270,7 @@ def make_train_epoch(
             sums = lax.psum(sums, DATA_AXIS)
             return params, opt_state, sums
 
-        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
-        shuffle_rng, dropout_rng = jax.random.split(rng)
+        shuffle_rng, dropout_rng = _epoch_rngs(rng, shard_axis)
         n_local = data.x.shape[0]
         n_steps = n_local // batch_size
         perm = jax.random.permutation(shuffle_rng, n_local)
@@ -244,7 +301,7 @@ def make_train_epoch(
         sums = lax.psum(sums, DATA_AXIS)
         return params, opt_state, sums
 
-    data_spec = Batch(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+    data_spec = epoch_data_spec(shard_axis)
     sharded = shard_map(
         local_epoch,
         mesh=mesh,
@@ -448,12 +505,18 @@ def window_eval_metrics(alpha, beta, y, factor, inv_psi) -> dict:
     """Per-window evaluation metrics: objective components + test-path MAE.
 
     Mirrors the reference's ``test_step`` (reference: src/model.py:119-141):
-    MAE of ``alpha + beta * r_market`` against realized returns, plus the
+    MAE of ``alpha + beta · factors`` against realized returns, plus the
     Gaussian NLL under the Woodbury inverse covariance, plus plain MSE.
     """
     r_target = y[:, :, 0]
-    r_market = y[:, :, 1]
-    r_pred = alpha + beta * r_market
+    n_f = beta.shape[-1]
+    if n_f == 1:
+        r_market = y[:, :, 1]
+        r_pred = alpha + beta * r_market
+    else:
+        r_pred = alpha + jnp.einsum(
+            "kf,ktf->kt", beta, y[:, :, 1 : 1 + n_f], precision="highest"
+        )
     n = jnp.float32(r_target.size)
     mse_loss, _ = mse_window(alpha, beta, y, factor, inv_psi)
     nll_loss, _ = nll_window(alpha, beta, y, factor, inv_psi)

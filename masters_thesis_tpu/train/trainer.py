@@ -71,16 +71,52 @@ from masters_thesis_tpu.telemetry import quality as quality_lib
 EVAL_CHUNK = 32
 
 
-def device_train_split(mesh, arrays: Batch) -> tuple[Batch, int]:
+def device_train_split(
+    mesh, arrays: Batch, axis: str = "window"
+) -> tuple[Batch, int]:
     """Shard the train split over the mesh; returns (device batch, n_local).
 
-    Truncates to a multiple of the mesh size (<= n_dev-1 windows dropped;
-    every window still rotates in via the per-epoch shard-local shuffle
-    being re-drawn — matches DDP sampler semantics). Module-level so the
-    stacked trainer (train/stacked.py) prepares data identically to the
-    single-run Trainer — replicas share one device-resident split.
+    ``axis='window'`` (default): truncates to a multiple of the mesh size
+    (<= n_dev-1 windows dropped; every window still rotates in via the
+    per-epoch shard-local shuffle being re-drawn — matches DDP sampler
+    semantics). Module-level so the stacked trainer (train/stacked.py)
+    prepares data identically to the single-run Trainer — replicas share one
+    device-resident split.
+
+    ``axis='asset'`` (universe-scale workloads): shards the ASSET rows
+    instead — ``x``/``y``/``inv_psi`` split on axis 1 (truncated to a
+    multiple of the mesh, <= n_dev-1 asset rows dropped) while the
+    per-window ``factor`` stats, which carry no asset axis, replicate.
+    ``n_local`` is then the full window count: every device sees the whole
+    window stream over its block of asset rows.
     """
     n_dev = mesh.size
+    if axis == "asset":
+        from masters_thesis_tpu.parallel import replicated_sharding
+
+        n_assets = arrays.x.shape[1]
+        k_local = n_assets // n_dev
+        if k_local == 0:
+            raise ValueError(
+                f"train split has {n_assets} assets < mesh size {n_dev}"
+            )
+        n_keep = k_local * n_dev
+        trunc = Batch(
+            arrays.x[:, :n_keep],
+            arrays.y[:, :n_keep],
+            arrays.factor,
+            arrays.inv_psi[:, :n_keep],
+        )
+        asset_sh = batch_sharding(mesh, batch_dim=1)
+        shardings = Batch(
+            asset_sh, asset_sh, replicated_sharding(mesh), asset_sh
+        )
+        dev = Batch(
+            *(global_put(a, s) for a, s in zip(trunc, shardings))
+        )
+        return dev, trunc.x.shape[0]
+    if axis != "window":
+        raise ValueError(f"unknown shard axis: {axis!r}")
     n = arrays.x.shape[0]
     n_local = n // n_dev
     if n_local == 0:
@@ -164,7 +200,16 @@ class Trainer:
         cost_profile: bool | None = None,
         metrics_port: int | None = None,
         slo_rules=None,
+        shard_axis: str = "window",
     ):
+        if shard_axis not in ("window", "asset"):
+            raise ValueError(f"unknown shard_axis: {shard_axis!r}")
+        if shard_axis == "asset" and epoch_mode != "scan":
+            raise ValueError(
+                "shard_axis='asset' requires epoch_mode='scan' (the stream "
+                "path prefetches window batches, which shard on windows)"
+            )
+        self.shard_axis = shard_axis
         self.max_epochs = max_epochs
         self.gradient_clip_val = gradient_clip_val
         # 'auto' defers the dtype to the per-shape measured policy
@@ -266,7 +311,7 @@ class Trainer:
     # ----------------------------------------------------------- data prep
 
     def _device_train_split(self, arrays: Batch) -> tuple[Batch, int]:
-        return device_train_split(self.mesh, arrays)
+        return device_train_split(self.mesh, arrays, axis=self.shard_axis)
 
     def _eval_split(self, arrays: Batch) -> tuple[Batch, jax.Array] | None:
         return prepare_eval_split(self.mesh, arrays)
@@ -298,7 +343,10 @@ class Trainer:
                 # telemetry event either way, so a failed preflight shows up
                 # in the run report, not only in a dead process' stderr.
                 try:
-                    assert_trace_clean(spec=spec, mesh=self.mesh)
+                    assert_trace_clean(
+                        spec=spec, mesh=self.mesh,
+                        shard_axis=self.shard_axis,
+                    )
                 except PreflightError as exc:
                     if tel:
                         tel.event(
@@ -474,7 +522,7 @@ class Trainer:
             steps_per_epoch = n_local // b_local
             epoch_fn = make_train_epoch(
                 module, objective, spec.metric_keys, tx, self.mesh,
-                batch_size=b_local,
+                batch_size=b_local, shard_axis=self.shard_axis,
             )
             hot_fn = epoch_fn
             data_cell["train"] = train_dev
@@ -863,6 +911,17 @@ class Trainer:
                     if stats.min_depth is not None:
                         tel.gauge("data/prefetch_min_depth").set(
                             stats.min_depth
+                        )
+                    if stats.mmap_bytes:
+                        # Store-backed epoch: page-in wait vs total data
+                        # wait, so `telemetry summarize` can split "slow
+                        # disk" from "slow producer" (window_store line).
+                        tel.event(
+                            "window_store",
+                            epoch=epoch,
+                            bytes_read=stats.mmap_bytes,
+                            fault_wait_s=round(stats.fault_wait_s, 6),
+                            get_wait_s=round(stats.get_wait_s, 6),
                         )
                     epoch_stats["cur"] = None
 

@@ -368,6 +368,20 @@ class TestTraceAudit:
         jax.block_until_ready(sums)
         assert epoch_fn._cache_size() == 1
 
+    def test_audit_is_clean_for_kfactor_asset_sharding(self):
+        """The universe-scale program holds every trace invariant: K=3
+        windows, asset axis sharded over the mesh, and still exactly one
+        all-reduce per dtype buffer in the scan body (TA206) plus the
+        single batched all-reduce in the stacked program (TA207)."""
+        from masters_thesis_tpu.models.objectives import ModelSpec
+
+        spec = ModelSpec(
+            objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+            kernel_impl="xla", n_factors=3,
+        )
+        findings = run_trace_audit(spec=spec, shard_axis="asset")
+        assert findings == [], format_report(findings)
+
     def test_audit_reports_infrastructure_failure_as_ta205(self):
         class NotASpec:
             pass

@@ -214,3 +214,78 @@ def test_window_cache_rebuilds_when_source_changes(tmp_path):
     after = np.array(dm2.train_arrays().x)
     assert before.shape == after.shape
     assert not np.allclose(before, after)
+
+
+# ------------------------------------------------------- K-factor pipeline
+
+
+def test_bootstrap_kfactor_writes_factor_series(tmp_path):
+    import json
+
+    bootstrap_synthetic(tmp_path, n_stocks=6, n_samples=600, seed=0, n_factors=3)
+    assert np.load(tmp_path / "factors.npy").shape == (3, 600)
+    assert np.load(tmp_path / "betas.npy").shape == (6, 3)
+    assert json.loads((tmp_path / "dgp.json").read_text())["n_factors"] == 3
+    # Re-bootstrapping the same dir at a different K is an error, not reuse.
+    with pytest.raises(ValueError, match="different data_dir"):
+        bootstrap_synthetic(
+            tmp_path, n_stocks=6, n_samples=600, seed=0, n_factors=5
+        )
+
+
+def test_bootstrap_k1_marker_is_unchanged_by_the_kfactor_path(tmp_path):
+    """Explicit ``n_factors=1`` must produce the exact pre-K dgp.json (no
+    ``n_factors`` key) so existing scalar datasets keep validating."""
+    import json
+
+    bootstrap_synthetic(
+        tmp_path / "a", n_stocks=4, n_samples=500, seed=0, n_factors=1
+    )
+    bootstrap_synthetic(tmp_path / "b", n_stocks=4, n_samples=500, seed=0)
+    assert (
+        (tmp_path / "a" / "dgp.json").read_bytes()
+        == (tmp_path / "b" / "dgp.json").read_bytes()
+    )
+    assert "n_factors" not in json.loads(
+        (tmp_path / "a" / "dgp.json").read_text()
+    )
+    assert not (tmp_path / "a" / "factors.npy").exists()
+    # And the scalar arrays themselves are the untouched K=1 DGP.
+    for name in ("stocks.npy", "market.npy", "alphas.npy", "betas.npy"):
+        np.testing.assert_array_equal(
+            np.load(tmp_path / "a" / name), np.load(tmp_path / "b" / name)
+        )
+
+
+def test_kfactor_window_schema(tmp_path):
+    """K=3 windows: x carries [rs, f_1..f_3, rs*f_k...] (2K+1 features with
+    interaction_only), y carries [r, f_1..f_3, alpha, beta_1..beta_3]
+    (2K+2 channels), factor carries [mean (K,) | cov.ravel() (K^2,)]."""
+    bootstrap_synthetic(tmp_path, n_stocks=6, n_samples=800, seed=0, n_factors=3)
+    dm = FinancialWindowDataModule(
+        tmp_path,
+        lookback_window=20,
+        target_window=10,
+        stride=30,
+        batch_size=2,
+        engine="python",
+    )
+    assert dm.n_factors == 3
+    assert dm.n_features == 7
+    dm.prepare_data(verbose=False)
+    dm.setup()
+    n_win = (800 - 30) // 30 + 1
+    full = dm._arrays
+    assert full.x.shape == (n_win, 6, 20, 7)
+    assert full.y.shape == (n_win, 6, 10, 8)
+    assert full.factor.shape == (n_win, 12)
+    assert full.inv_psi.shape == (n_win, 6)
+    # Ground-truth label channels are the sampled alpha/beta constants.
+    alphas = np.load(tmp_path / "alphas.npy")
+    betas = np.load(tmp_path / "betas.npy")
+    y = np.asarray(full.y)
+    np.testing.assert_allclose(y[..., 4], np.broadcast_to(
+        alphas[None, :, None], y.shape[:3]), rtol=1e-6)
+    for k in range(3):
+        np.testing.assert_allclose(y[..., 5 + k], np.broadcast_to(
+            betas[None, :, k, None], y.shape[:3]), rtol=1e-6)

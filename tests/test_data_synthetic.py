@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from masters_thesis_tpu.data import SyntheticLogReturns
+from masters_thesis_tpu.data.synthetic import SyntheticKFactorReturns
 
 
 def test_generate_shapes_and_dtype():
@@ -52,6 +53,67 @@ def test_factor_structure_regression_recovers_beta():
     np.testing.assert_allclose(beta_hat, betas, atol=0.05)
     alpha_hat = s.mean(1) - beta_hat * m.mean()
     np.testing.assert_allclose(alpha_hat, alphas, atol=0.05)
+
+
+def test_kfactor_shapes_and_dtype():
+    r, f, a, b = SyntheticKFactorReturns.generate(7, 500, n_factors=3, seed=0)
+    assert r.shape == (7, 500)
+    assert f.shape == (3, 500)
+    assert a.shape == (7,)
+    assert b.shape == (7, 3)
+    assert all(x.dtype == np.float32 for x in (r, f, a, b))
+
+
+def test_kfactor_is_deterministic_in_seed():
+    a = SyntheticKFactorReturns.generate(3, 100, n_factors=3, seed=42)
+    b = SyntheticKFactorReturns.generate(3, 100, n_factors=3, seed=42)
+    c = SyntheticKFactorReturns.generate(3, 100, n_factors=3, seed=43)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert not np.array_equal(a[0], c[0])
+    with pytest.raises(ValueError):
+        SyntheticKFactorReturns.generate(3, 100, n_factors=0)
+    with pytest.raises(ValueError):
+        SyntheticKFactorReturns.generate(3, 100, n_factors=3, variant="bogus")
+
+
+def test_kfactor_factor_moments():
+    """Factor 0 keeps the market's Student-t drift; style factors are
+    zero-mean with the same scale/tails."""
+    _, f, _, _ = SyntheticKFactorReturns.generate(
+        1, 200_000, n_factors=3, seed=1
+    )
+    p = SyntheticLogReturns.mkt_params
+    expected_var = p["scale"] ** 2 * p["df"] / (p["df"] - 2.0)
+    assert abs(f[0].mean() - p["loc"]) < 0.02
+    for k in (1, 2):
+        assert abs(f[k].mean()) < 0.02
+        assert abs(f[k].var() - expected_var) < 0.15 * expected_var
+
+
+def test_kfactor_loading_cross_section():
+    """Market loadings keep the reference Normal cross-section; style
+    loadings are zero-centered with the same dispersion."""
+    _, _, _, b = SyntheticKFactorReturns.generate(
+        20_000, 2, n_factors=3, seed=2
+    )
+    pb = SyntheticLogReturns.beta_params
+    assert abs(b[:, 0].mean() - pb["loc"]) < 0.02
+    for k in (1, 2):
+        assert abs(b[:, k].mean()) < 0.02
+        assert abs(b[:, k].std() - pb["scale"]) < 0.02
+
+
+def test_kfactor_regression_recovers_loadings():
+    """Multivariate OLS on the generated panel must recover the sampled
+    alpha/beta — the K-factor synthetic-oracle contract."""
+    r, f, alphas, betas = SyntheticKFactorReturns.generate(
+        10, 50_000, n_factors=3, seed=3
+    )
+    design = np.concatenate([np.ones((f.shape[1], 1)), f.T], axis=-1)
+    coef, *_ = np.linalg.lstsq(design, r.T, rcond=None)
+    np.testing.assert_allclose(coef[0], alphas, atol=0.05)
+    np.testing.assert_allclose(coef[1:].T, betas, atol=0.05)
 
 
 def test_outliers_variant_differs_and_matches_params():

@@ -8,7 +8,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from masters_thesis_tpu.ops import ols, inverse_returns_covariance
+import pytest
+
+from masters_thesis_tpu.ops import inverse_returns_covariance, ols, ols_k
 
 
 def _lstsq_oracle(x, y):
@@ -16,6 +18,17 @@ def _lstsq_oracle(x, y):
     design = np.stack([np.ones_like(x), x], axis=-1)
     coef, *_ = np.linalg.lstsq(design, y.T, rcond=None)
     return coef[0], coef[1]
+
+
+def _lstsq_k_oracle(f, y):
+    """Per-row numpy lstsq fit of y ≈ a + B f with F regressors.
+
+    ``f``: (T, F) factor returns; ``y``: (K, T). Returns (alphas (K,),
+    betas (K, F)).
+    """
+    design = np.concatenate([np.ones((f.shape[0], 1)), f], axis=-1)
+    coef, *_ = np.linalg.lstsq(design, y.T, rcond=None)
+    return coef[0], coef[1:].T
 
 
 def test_ols_unbatched_matches_lstsq(rng):
@@ -63,6 +76,63 @@ def test_ols_is_jittable(rng):
     y = jnp.asarray(rng.normal(size=(2, 3, 16)).astype(np.float32))
     eager = ols(x, y)
     jitted = jax.jit(ols)(x, y)
+    np.testing.assert_allclose(eager[0], jitted[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(eager[1], jitted[1], rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n_f", [1, 3, 5])
+def test_ols_k_matches_lstsq(rng, n_f):
+    f = rng.normal(size=(40, n_f)).astype(np.float32)
+    y = rng.normal(size=(6, 40)).astype(np.float32)
+    alphas, betas = ols_k(jnp.asarray(f), jnp.asarray(y))
+    a_ref, b_ref = _lstsq_k_oracle(f, y)
+    assert alphas.shape == (6,) and betas.shape == (6, n_f)
+    np.testing.assert_allclose(alphas, a_ref, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(betas, b_ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("n_f", [1, 3])
+def test_ols_k_batched_matches_lstsq(rng, n_f):
+    f = rng.normal(size=(4, 30, n_f)).astype(np.float32)
+    y = rng.normal(size=(4, 5, 30)).astype(np.float32)
+    alphas, betas = ols_k(jnp.asarray(f), jnp.asarray(y))
+    assert alphas.shape == (4, 5) and betas.shape == (4, 5, n_f)
+    for b in range(4):
+        a_ref, b_ref = _lstsq_k_oracle(f[b], y[b])
+        np.testing.assert_allclose(alphas[b], a_ref, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(betas[b], b_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_ols_k_single_factor_bitwise_matches_ols(rng):
+    # The K=1 branch of ols_k IS the scalar path op-for-op — the
+    # bit-identity contract that keeps existing runs reproducible.
+    x = rng.normal(size=(4, 30)).astype(np.float32)
+    y = rng.normal(size=(4, 5, 30)).astype(np.float32)
+    a1, b1 = ols(jnp.asarray(x), jnp.asarray(y))
+    ak, bk = ols_k(jnp.asarray(x)[..., None], jnp.asarray(y))
+    assert np.array_equal(np.asarray(a1), np.asarray(ak))
+    assert np.array_equal(np.asarray(b1), np.asarray(bk)[..., 0])
+
+
+def test_ols_k_recovers_exact_plane():
+    t = 24
+    f = jnp.stack(
+        [jnp.linspace(-1.0, 1.0, t), jnp.linspace(2.0, -1.0, t) ** 2],
+        axis=-1,
+    )
+    true_a = jnp.asarray([0.5, -1.5])
+    true_b = jnp.asarray([[2.0, -0.5], [1.0, 3.0]])
+    y = true_a[:, None] + true_b @ f.T
+    alphas, betas = ols_k(f, y)
+    np.testing.assert_allclose(np.asarray(alphas), true_a, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(betas), true_b, atol=1e-4)
+
+
+def test_ols_k_is_jittable(rng):
+    f = jnp.asarray(rng.normal(size=(2, 16, 3)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(2, 5, 16)).astype(np.float32))
+    eager = ols_k(f, y)
+    jitted = jax.jit(ols_k)(f, y)
     np.testing.assert_allclose(eager[0], jitted[0], rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(eager[1], jitted[1], rtol=1e-5, atol=1e-6)
 

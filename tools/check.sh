@@ -24,6 +24,15 @@ fi
 echo "== tracelint =="
 JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis || fail=1
 
+# 2a. The same trace audit (TA201-TA207) on the universe-scale K=3
+#     asset-sharded program: the K-factor epoch must hold the identical
+#     invariants — one compile, clean transfer guard, params replicated +
+#     per-asset leaves sharded (factor stats replicated BY DESIGN), and
+#     still exactly one all-reduce per dtype buffer in the scan body.
+echo "== tracelint (K=3 universe, asset-sharded) =="
+JAX_PLATFORMS=cpu python -m masters_thesis_tpu.analysis --skip-lint \
+    --n-factors 3 --shard-axis asset || fail=1
+
 # 2b. Pass 3: concurrency lint (CL501-CL505 — lock-order inversions,
 #     unguarded shared state, blocking calls under locks / in signal
 #     handlers, thread lifecycle) + event-schema contract check

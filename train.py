@@ -45,6 +45,16 @@ def _register_resolvers() -> None:
         "input_size_from_interaction",
         lambda interaction: 3 if interaction else 5,
     )
+    # K-factor generalization of the above: features are
+    # [r_stock, f_1..f_K, r_stock*f_k...] (2K+1) with interaction_only,
+    # plus the squared channels (3K+2) otherwise. At K=1 this reduces to
+    # the scalar resolver's 3/5.
+    register_resolver(
+        "input_size_from_factors",
+        lambda interaction, n_factors: (
+            2 * int(n_factors) + 1 if interaction else 3 * int(n_factors) + 2
+        ),
+    )
 
 
 _register_resolvers()
@@ -59,7 +69,9 @@ def bootstrap(cfg: Config) -> bool:
     from masters_thesis_tpu.data.pipeline import bootstrap_real, bootstrap_synthetic
 
     dmcfg = cfg.datamodule
-    if dmcfg.name == "synthetic":
+    # Synthetic-DGP datamodules (synthetic, universe) carry n_stocks; the
+    # real datamodule carries raw_dir instead.
+    if "n_stocks" in dmcfg:
         # The DGP seed is its own key (default 0), NOT cfg.seed: sweeping the
         # training seed must not invalidate (or conflict with) a shared
         # bootstrapped dataset.
@@ -69,6 +81,7 @@ def bootstrap(cfg: Config) -> bool:
             n_samples=dmcfg.n_samples,
             seed=dmcfg.get("dgp_seed", 0),
             variant=dmcfg.get("dgp_variant", "no_outliers"),
+            n_factors=dmcfg.get("n_factors", 1),
         )
         return True
     if not bootstrap_real(Path(dmcfg.raw_dir), Path(dmcfg.data_dir)):
@@ -94,6 +107,7 @@ def build_datamodule(cfg: Config):
         interaction_only=d.interaction_only,
         batch_size=d.batch_size,
         engine=d.get("engine", "auto"),
+        store_shards=d.get("store_shards", None),
     )
 
 
@@ -106,6 +120,7 @@ def build_spec(cfg: Config):
         hidden_size=cfg.model.hidden_size,
         num_layers=cfg.model.num_layers,
         dropout=cfg.model.dropout,
+        n_factors=cfg.model.get("n_factors", 1),
         learning_rate=cfg.model.learning_rate,
         weight_decay=cfg.model.weight_decay,
         remat=cfg.model.get("remat", False),
@@ -167,6 +182,7 @@ def run(cfg: Config) -> float:
         check_val_every_n_epoch=t.get("check_val_every_n_epoch", 1),
         strategy=t.strategy,
         epoch_mode=t.epoch_mode,
+        shard_axis=t.get("shard_axis", "window"),
         n_devices=t.get("n_devices", None),
         enable_progress_bar=t.enable_progress_bar,
         enable_model_summary=t.enable_model_summary,
