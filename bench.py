@@ -1126,6 +1126,375 @@ def _serve_sustained_bench() -> int:
     return 0
 
 
+# --------------------------------------------------------- --serve-stacked
+# Multi-tenant stacked-inference bench: ONE StackedPredictEngine serves R
+# checkpoints through one AOT program per bucket, ramped open-loop to its
+# knee at each R against a solo-engine baseline. The headline is aggregate
+# model-answers/sec (R x delivered QPS at the knee): a stacked dispatch
+# answers for all R tenants at once, so aggregate throughput must scale
+# well past the solo engine while per-request p99 stays bounded. The bench
+# also drives a lane hot-swap and a replica kill under live load — both
+# must deliver zero late answers, and the swap zero new compiles. Exits
+# nonzero on any invariant or scaling-criteria miss.
+STACKED_SERVE_LANES = (1, 2, 4, 8)
+STACKED_SERVE_BUCKETS = (1, 4, 8)
+STACKED_SERVE_STOCKS = 4
+STACKED_SERVE_LOOKBACK = 4
+STACKED_SERVE_STAGE_S = 1.2
+STACKED_SERVE_RAMP = 1.5
+STACKED_SERVE_MAX_STAGES = 6
+STACKED_SERVE_SHED_PCT_MAX = 10.0
+STACKED_SERVE_MIN_SCALE = 3.0  # R=8 aggregate answers/sec >= 3x solo
+STACKED_SERVE_MAX_P99_X = 2.0  # R=8 p99 <= 2x solo p99, matched load
+# Fixed offered load for the tail-latency comparison. Knee p99 is an
+# overload artifact (each engine's last sustainable stage sits at a
+# different depth past saturation), so the <=2x bound is judged at one
+# common light load that every R sustains.
+STACKED_SERVE_REF_QPS = 400.0
+
+
+def _serve_stacked_bench() -> int:
+    """One JSON line: stacked R-scaling; ledger rows serve_stacked/R=<r>."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8"
+        ).strip()
+    _pin_cpu_in_process()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from masters_thesis_tpu.models.objectives import ModelSpec
+    from masters_thesis_tpu.serve.engine import PredictEngine, resolve_buckets
+    from masters_thesis_tpu.serve.server import PredictServer
+    from masters_thesis_tpu.serve.stacked import StackedPredictEngine
+
+    t0 = time.perf_counter()
+    if "--buckets" in sys.argv:
+        buckets = resolve_buckets(sys.argv[sys.argv.index("--buckets") + 1])
+    else:
+        buckets = resolve_buckets(
+            os.environ.get("MTT_SERVE_BUCKETS") or STACKED_SERVE_BUCKETS
+        )
+    # Deliberately tiny geometry: stacked serving pays R x the lane
+    # compute inside one dispatch, so the win is amortized DISPATCH
+    # overhead — the regime universe cross-section serving actually runs
+    # in (many tenants, small per-window compute).
+    k, t, f = STACKED_SERVE_STOCKS, STACKED_SERVE_LOOKBACK, SERVE_FEATURES
+    spec = ModelSpec(
+        objective="mse", hidden_size=8, num_layers=1, dropout=0.0,
+        kernel_impl="xla",
+    )
+    module = spec.build_module()
+    max_r = max(STACKED_SERVE_LANES)
+    params = [
+        module.init(
+            jax.random.key(seed), jnp.zeros((1, t, f), jnp.float32)
+        )["params"]
+        for seed in range(max_r + 1)  # +1: the lane-swap candidate
+    ]
+    rng = np.random.default_rng(0)
+    windows = rng.standard_normal((8, k, t, f)).astype(np.float32)
+    late_total = 0
+
+    def run_stage(server, qps: float, deadline_s: float, r_lanes: int,
+                  slo_ms: float) -> dict:
+        gap = 1.0 / qps
+        pendings = []
+        t_end = time.monotonic() + STACKED_SERVE_STAGE_S
+        i = 0
+        while time.monotonic() < t_end:
+            # Fan the offered load across one tenant per lane, so the
+            # per-tenant admission accounting runs under real load.
+            pendings.append(server.submit(
+                windows[i % 8], deadline_s, tenant=f"t{i % r_lanes}"
+            ))
+            i += 1
+            time.sleep(gap)
+        ok_lat: list[float] = []
+        shed = 0
+        for p in pendings:
+            r = p.result(timeout=60.0)
+            if r.ok:
+                ok_lat.append(r.latency_s * 1e3)
+            elif r.status == "shed":
+                shed += 1
+        n = len(pendings) or 1
+        ok_lat.sort()
+        p99 = (
+            ok_lat[min(len(ok_lat) - 1, int(0.99 * len(ok_lat)))]
+            if ok_lat else None
+        )
+        stage = {
+            "offered_qps": round(qps, 2),
+            "requests": len(pendings),
+            "completed": len(ok_lat),
+            "delivered_qps": round(len(ok_lat) / STACKED_SERVE_STAGE_S, 2),
+            "shed_pct": round(100.0 * shed / n, 2),
+            "p99_ms": None if p99 is None else round(p99, 3),
+        }
+        stage["sustainable"] = (
+            stage["completed"] > 0
+            and stage["shed_pct"] <= STACKED_SERVE_SHED_PCT_MAX
+            and stage["p99_ms"] is not None
+            and stage["p99_ms"] <= slo_ms
+        )
+        return stage
+
+    def run_ramp(engine, r_lanes: int) -> dict:
+        nonlocal late_total
+        server = PredictServer(engine, max_wait_s=0.002)
+        server.start()
+        batch_s = server.service_model.batch_s
+        deadline_s = max(0.05, 20.0 * batch_s)
+        slo_ms = deadline_s * 1e3
+        # One fixed light-load stage first: the matched point every R's
+        # tail latency is compared at (knee p99 depends on overload depth).
+        ref = run_stage(
+            server, STACKED_SERVE_REF_QPS, deadline_s, r_lanes, slo_ms
+        )
+        stages: list[dict] = []
+        knee = None
+        qps = max(1.0, 0.25 * engine.max_bucket / batch_s)
+        for _ in range(STACKED_SERVE_MAX_STAGES):
+            stage = run_stage(server, qps, deadline_s, r_lanes, slo_ms)
+            stages.append(stage)
+            if not stage["sustainable"]:
+                break
+            knee = stage
+            qps *= STACKED_SERVE_RAMP
+        stats = server.stop()
+        late_total += int(stats["late_deliveries"])
+        knee_delivered = 0.0 if knee is None else knee["delivered_qps"]
+        return {
+            "lanes": r_lanes,
+            "deadline_ms": round(slo_ms, 1),
+            "ref_qps": STACKED_SERVE_REF_QPS,
+            "ref_p99_ms": ref["p99_ms"],
+            "stages": stages,
+            "knee_qps": None if knee is None else knee["offered_qps"],
+            "p99_at_knee_ms": None if knee is None else knee["p99_ms"],
+            "shed_pct_at_knee": None if knee is None else knee["shed_pct"],
+            "delivered_qps_at_knee": knee_delivered,
+            "answers_per_sec": round(r_lanes * knee_delivered, 2),
+            "compile_events": int(engine.compile_events),
+            "tenants": stats.get("tenants"),
+            "late_deliveries": int(stats["late_deliveries"]),
+        }
+
+    # Solo baseline: the single-checkpoint engine every prior round
+    # benched — the stacked engine's scaling is judged against it.
+    solo_engine = PredictEngine(
+        spec, params[0], n_stocks=k, lookback=t, n_features=f,
+        buckets=buckets,
+    )
+    solo = run_ramp(solo_engine, 1)
+    platform = solo_engine.platform
+
+    ramps: dict[int, dict] = {}
+    engines: dict[int, StackedPredictEngine] = {}
+    for r_lanes in STACKED_SERVE_LANES:
+        eng = StackedPredictEngine(
+            spec, params[:r_lanes], n_stocks=k, lookback=t,
+            n_features=f, buckets=buckets,
+        )
+        engines[r_lanes] = eng
+        ramps[r_lanes] = run_ramp(eng, r_lanes)
+
+    # Lane hot-swap under live load on the widest stack: zero new
+    # compiles, zero late answers, siblings bit-untouched.
+    eng = engines[max_r]
+    swap_server = PredictServer(eng, max_wait_s=0.002)
+    swap_server.start()
+    swap_deadline_s = max(0.05, 20.0 * swap_server.service_model.batch_s)
+    swap_qps = max(
+        4.0, 0.5 * (ramps[max_r]["knee_qps"] or 8.0)
+    )
+    gx = eng.golden_batch(min(2, eng.max_bucket), seed=5)
+    pre_a, pre_b = eng.predict(gx)
+    baseline_compiles = eng.compile_events
+    pendings = []
+    n_swap_requests = max(16, int(swap_qps * STACKED_SERVE_STAGE_S))
+    for i in range(n_swap_requests):
+        if i == n_swap_requests // 2:
+            eng.set_lane(max_r - 1, params[max_r])
+        pendings.append(swap_server.submit(
+            windows[i % 8], swap_deadline_s, tenant=f"t{i % max_r}"
+        ))
+        time.sleep(1.0 / swap_qps)
+    swap_ok = sum(1 for p in pendings if p.result(timeout=60.0).ok)
+    swap_stats = swap_server.stop()
+    late_total += int(swap_stats["late_deliveries"])
+    post_a, post_b = eng.predict(gx)
+    siblings_bitwise = all(
+        np.array_equal(pre_a[:, r, :], post_a[:, r, :])
+        and np.array_equal(pre_b[:, r, :], post_b[:, r, :])
+        for r in range(max_r) if r != max_r - 1
+    )
+    swap = {
+        "lane": max_r - 1,
+        "requests": n_swap_requests,
+        "served_ok": swap_ok,
+        "compile_delta": int(eng.compile_events - baseline_compiles),
+        "late_deliveries": int(swap_stats["late_deliveries"]),
+        "siblings_bitwise": siblings_bitwise,
+    }
+
+    # Replica kill under load: a 2-replica stacked fleet loses one to an
+    # injected dispatch crash; every request resolves explicitly and
+    # nothing is delivered late.
+    from masters_thesis_tpu.resilience import faults
+    from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
+    from masters_thesis_tpu.serve.fleet import FleetServer, partition_meshes
+
+    meshes = partition_meshes(2)
+
+    def factory_for(m):
+        return lambda: StackedPredictEngine(
+            spec, params[:4], n_stocks=k, lookback=t, n_features=f,
+            buckets=buckets, mesh=m,
+        )
+
+    fleet = FleetServer(
+        {f"r{i}": factory_for(m) for i, m in enumerate(meshes)},
+        max_wait_s=0.002,
+        hang_timeout_s=2.0,
+        restart_policy=ReplicaRestartPolicy(backoff_s=0.01),
+    )
+    fleet.start()
+    plan = faults.FaultPlan(faults=[faults.FaultSpec(
+        point="serve.replica_dispatch", kind="raise",
+        attempt=None, match={"replica": "r0"},
+    )])
+    faults.install_plan(plan)
+    try:
+        chaos_pend = [
+            fleet.submit(windows[i % 8], deadline_s=2.0)
+            for i in range(30)
+        ]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if fleet.replicas["r0"].state == "dead":
+                break
+            time.sleep(0.01)
+        chaos_results = [p.result(timeout=10.0) for p in chaos_pend]
+    finally:
+        faults.clear_plan()
+    chaos_stats = fleet.stop()
+    late_total += int(chaos_stats["late_deliveries"])
+    chaos_bad = sorted({
+        r.status for r in chaos_results
+        if r.status not in ("ok", "shed", "rejected_late")
+    })
+    chaos = {
+        "replicas": 2,
+        "lanes": 4,
+        "deaths": int(chaos_stats["deaths"]),
+        "n_live_after": int(chaos_stats["n_live"]),
+        "late_deliveries": int(chaos_stats["late_deliveries"]),
+        "non_explicit_statuses": chaos_bad,
+    }
+
+    solo_aps = solo["answers_per_sec"]
+    top = ramps[max_r]
+    scale_x = (
+        None if not solo_aps
+        else round(top["answers_per_sec"] / solo_aps, 2)
+    )
+    p99_x = (
+        None
+        if not solo["ref_p99_ms"] or not top["ref_p99_ms"]
+        else round(top["ref_p99_ms"] / solo["ref_p99_ms"], 2)
+    )
+    result = {
+        "metric": "serve_stacked_answers_per_sec",
+        "value": top["answers_per_sec"],
+        "unit": "answers/s",
+        "detail": {
+            "device": platform,
+            "wall_s": round(time.perf_counter() - t0, 1),
+            "buckets": list(buckets),
+            "window": [k, t, f],
+            "solo": solo,
+            "stacked": {str(r): ramps[r] for r in STACKED_SERVE_LANES},
+            "scale_x_vs_solo": scale_x,
+            "p99_x_vs_solo": p99_x,
+            "lane_swap": swap,
+            "replica_kill": chaos,
+            "late_deliveries": late_total,
+        },
+    }
+    try:
+        from masters_thesis_tpu.telemetry.ledger import (
+            DEFAULT_LEDGER_PATH,
+            append_record,
+            ledger_record,
+        )
+
+        path = Path(__file__).resolve().parent / DEFAULT_LEDGER_PATH
+        round_id = os.environ.get("MTT_BENCH_ROUND") or time.strftime(
+            "%Y%m%dT%H%M%S"
+        )
+        for r_lanes in STACKED_SERVE_LANES:
+            row = ramps[r_lanes]
+            append_record(path, ledger_record(
+                point=f"serve_stacked/R={r_lanes}",
+                round_id=round_id,
+                platform=platform,
+                steps_per_sec=None,
+                objective="mse",
+                knee_qps=row["knee_qps"],
+                p99_at_knee_ms=row["p99_at_knee_ms"],
+                ref_p99_ms=row["ref_p99_ms"],
+                shed_pct_at_knee=row["shed_pct_at_knee"],
+                answers_per_sec=row["answers_per_sec"],
+                solo_answers_per_sec=solo_aps,
+                buckets=list(buckets),
+            ))
+    except Exception as exc:  # noqa: BLE001 — observability, not the bench
+        print(f"perf ledger append failed: {exc!r}", file=sys.stderr)
+    print(json.dumps(result))
+
+    failed = []
+    if late_total:
+        failed.append(
+            f"{late_total} late deliveries (no-late-answers broken)"
+        )
+    if swap["compile_delta"]:
+        failed.append(
+            f"lane swap compiled {swap['compile_delta']} program(s) — a "
+            "row write must never retrace"
+        )
+    if not swap["siblings_bitwise"]:
+        failed.append("lane swap perturbed a sibling lane's outputs")
+    if not swap["served_ok"]:
+        failed.append("zero ok responses through the lane swap")
+    if chaos["deaths"] < 1:
+        failed.append("injected crash never killed the victim replica")
+    if chaos["n_live_after"] < 1 and chaos["deaths"]:
+        failed.append("no stacked replica survived the kill")
+    if chaos["non_explicit_statuses"]:
+        failed.append(
+            f"non-explicit request outcomes {chaos['non_explicit_statuses']}"
+        )
+    if scale_x is None or scale_x < STACKED_SERVE_MIN_SCALE:
+        failed.append(
+            f"R={max_r} aggregate scaling {scale_x}x < "
+            f"{STACKED_SERVE_MIN_SCALE}x solo"
+        )
+    if p99_x is None or p99_x > STACKED_SERVE_MAX_P99_X:
+        failed.append(
+            f"R={max_r} matched-load p99 {p99_x}x solo exceeds the "
+            f"{STACKED_SERVE_MAX_P99_X}x bound"
+        )
+    if failed:
+        print("serve-stacked: " + "; ".join(failed), file=sys.stderr)
+        return 1
+    return 0
+
+
 def _detail_cost(cost: dict | None) -> dict | None:
     """The JSON-line's `detail.cost`: the roofline essentials of the
     headline point (full payloads live in the ledger/telemetry stream)."""
@@ -1770,10 +2139,11 @@ def main() -> None:
             print(format_report(findings), file=sys.stderr)
             sys.exit(2)
         print("preflight: trace audit ok", file=sys.stderr)
-        # Serving twin (SV301–SV306: zero recompiles, no implicit
-        # transfers, warm-cache zero-compile boot, single-death survival)
-        # runs in a child so its forced 8-device CPU mesh can never leak
-        # into this process's backend selection.
+        # Serving twin (SV301–SV308: zero recompiles, no implicit
+        # transfers, warm-cache zero-compile boot, single-death survival,
+        # one stacked program per bucket at any lane count, zero-compile
+        # lane hot-swap) runs in a child so its forced 8-device CPU mesh
+        # can never leak into this process's backend selection.
         import subprocess
 
         serve_pf = subprocess.run(
@@ -2061,6 +2431,8 @@ def _carry_last_tpu(cache: Path, results_dir: Path) -> dict | None:
 if __name__ == "__main__":
     if "--serve-sustained" in sys.argv:
         sys.exit(_serve_sustained_bench())
+    elif "--serve-stacked" in sys.argv:
+        sys.exit(_serve_stacked_bench())
     elif "--serve" in sys.argv:
         if "--telemetry-dir" in sys.argv:
             i = sys.argv.index("--telemetry-dir")

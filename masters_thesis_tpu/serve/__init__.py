@@ -33,6 +33,7 @@ from masters_thesis_tpu.serve.queue import (
     ServeRequest,
     ServeResponse,
     ServiceTimeModel,
+    TenantClass,
 )
 from masters_thesis_tpu.serve.fleet import (
     FleetServer,
@@ -54,11 +55,23 @@ _LAZY = {
     "BucketOverflowError": (
         "masters_thesis_tpu.serve.engine", "BucketOverflowError",
     ),
+    "resolve_buckets": ("masters_thesis_tpu.serve.engine", "resolve_buckets"),
+    "StackedPredictEngine": (
+        "masters_thesis_tpu.serve.stacked", "StackedPredictEngine",
+    ),
+    "LaneMismatchError": (
+        "masters_thesis_tpu.serve.stacked", "LaneMismatchError",
+    ),
+    "ensemble_stats": ("masters_thesis_tpu.serve.stacked", "ensemble_stats"),
+    "lane_digest": ("masters_thesis_tpu.serve.stacked", "lane_digest"),
     "CheckpointSwapper": ("masters_thesis_tpu.serve.swap", "CheckpointSwapper"),
     "SwapVerdict": ("masters_thesis_tpu.serve.swap", "SwapVerdict"),
     "canary_checks": ("masters_thesis_tpu.serve.swap", "canary_checks"),
     "run_serve_preflight": (
         "masters_thesis_tpu.serve.preflight", "run_serve_preflight",
+    ),
+    "run_stacked_preflight": (
+        "masters_thesis_tpu.serve.preflight", "run_stacked_preflight",
     ),
     "assert_serve_clean": (
         "masters_thesis_tpu.serve.preflight", "assert_serve_clean",
@@ -93,5 +106,6 @@ __all__ = [
     "ServeRequest",
     "ServeResponse",
     "ServiceTimeModel",
+    "TenantClass",
     *sorted(_LAZY),
 ]

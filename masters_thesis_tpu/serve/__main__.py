@@ -12,10 +12,12 @@ Subcommands:
   8-device virtual CPU mesh: every bucket compiles exactly once, zero
   compile delta in steady state, hot path clean under
   ``transfer_guard("disallow")`` (SV301–SV304), plus the fleet-era rules:
-  warm program-cache boot performs zero compiles (SV305) and a single
+  warm program-cache boot performs zero compiles (SV305), a single
   injected replica death leaves >= 1 serving replica with every request
-  explicitly resolved (SV306). Exit 1 on findings; the other
-  tools/check.sh serve gate.
+  explicitly resolved (SV306), stacked multi-tenant serving compiles one
+  program per bucket regardless of lane count (SV307), and a per-lane
+  hot-swap is zero-compile with zero late answers (SV308). Exit 1 on
+  findings; the other tools/check.sh serve gate.
 """
 
 from __future__ import annotations
@@ -63,6 +65,21 @@ class _FakeEngine:
     def degrade_to_cpu(self) -> None:
         self.degraded = True
         self.fail_next = 0
+
+
+class _FakeStackedEngine(_FakeEngine):
+    """Stacked-engine stand-in: per-lane ``(n, R, K)`` outputs, so the
+    selfcheck can prove the queue/server plumbing is lane-shape-agnostic
+    without importing jax."""
+
+    def __init__(self, lanes: int = 3, **kw):
+        super().__init__(**kw)
+        self.num_lanes = lanes
+
+    def predict(self, x, params=None):
+        a, b = super().predict(x, params)
+        a = self._np.repeat(a[:, None, :], self.num_lanes, axis=1)
+        return a, a.copy()
 
 
 class _StubHealth:
@@ -250,6 +267,48 @@ def _selfcheck(args) -> int:
     if bad:
         failures.append(f"fleet: non-explicit outcomes {sorted(set(bad))}")
 
+    # 7. Multi-tenant stacked serving, jax-free: a deadline-classed tenant
+    #    submits WITHOUT a per-request deadline, a second tenant rides
+    #    along, per-tenant accounting splits cleanly, and stacked
+    #    (R, K)-per-window responses resolve through the unchanged
+    #    dispatch loop.
+    engine = _FakeStackedEngine(lanes=3, service_s=0.001)
+    server = PredictServer(engine, max_wait_s=0.002)
+    server.register_tenant("quant-a", deadline_s=5.0)
+    server.start()
+    pending = [server.submit(window, tenant="quant-a") for _ in range(6)]
+    pending += [
+        server.submit(window, deadline_s=5.0, tenant="quant-b")
+        for _ in range(4)
+    ]
+    results = [p.result(timeout=10.0) for p in pending]
+    try:
+        server.submit(window, tenant="no-class")
+        failures.append("tenancy: deadline-less submit for an unclassed "
+                        "tenant was admitted")
+    except ValueError:
+        pass
+    stats = server.stop()
+    if not all(r.status == STATUS_OK for r in results):
+        failures.append(
+            "tenancy: statuses "
+            f"{sorted({r.status for r in results})} != ['ok']"
+        )
+    lane_shapes = {r.outputs[0].shape for r in results if r.outputs}
+    if lane_shapes != {(3, 2)}:
+        failures.append(
+            f"tenancy: stacked per-request outputs {lane_shapes} != "
+            "{(3, 2)} (lanes, stocks)"
+        )
+    tstats = stats.get("tenants", {})
+    if (
+        tstats.get("quant-a", {}).get("admitted") != 6
+        or tstats.get("quant-b", {}).get("admitted") != 4
+    ):
+        failures.append(f"tenancy: per-tenant admission split {tstats}")
+    if stats.get("lanes") != 3:
+        failures.append(f"tenancy: stats lanes {stats.get('lanes')} != 3")
+
     if failures:
         print("serve: selfcheck FAILED: " + "; ".join(failures))
         return 1
@@ -279,16 +338,20 @@ def _preflight(args) -> int:
         run_fleet_preflight,
         run_program_cache_preflight,
         run_serve_preflight,
+        run_stacked_preflight,
     )
 
     findings = run_serve_preflight(requests=args.requests)
     findings += run_program_cache_preflight()
     findings += run_fleet_preflight()
+    findings += run_stacked_preflight(requests=args.requests)
     print(format_report(findings, as_json=args.json))
     if not findings and not args.json:
         print(
             "serve: preflight ok (zero recompiles, transfer-clean, "
-            "warm-cache boot compile-free, fleet survives replica death)"
+            "warm-cache boot compile-free, fleet survives replica death, "
+            "stacked lanes share one program per bucket, lane swap is "
+            "zero-compile with zero late answers)"
         )
     return 1 if findings else 0
 

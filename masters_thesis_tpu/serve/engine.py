@@ -47,6 +47,27 @@ from masters_thesis_tpu.train.steps import forward_rows
 DEFAULT_BUCKETS = (1, 2, 4, 8)
 
 
+def resolve_buckets(value: Any = None) -> tuple[int, ...]:
+    """Normalize a bucket ladder from config/CLI into the engine's form.
+
+    Accepts ``None`` (the code default), a sequence of ints (the
+    ``serve.buckets`` config list — configs/serve/*.yaml), or a
+    comma-separated string (CLI overrides like ``--buckets 1,8,64``).
+    The default ladder tops out at 8 windows — sized for interactive
+    traffic; universe-scale batches (thousands of windows per request,
+    configs/serve/universe.yaml) need their own profile, which is why
+    the ladder is config, not code.
+    """
+    if value is None:
+        return DEFAULT_BUCKETS
+    if isinstance(value, str):
+        value = [v for v in value.replace(",", " ").split() if v]
+    buckets = tuple(sorted(set(int(b) for b in value)))
+    if not buckets or buckets[0] < 1:
+        raise ValueError(f"invalid serve bucket ladder: {value!r}")
+    return buckets
+
+
 class BucketOverflowError(ValueError):
     """Request batch larger than the largest compiled bucket."""
 

@@ -60,6 +60,7 @@ import numpy as np
 from masters_thesis_tpu.resilience import faults
 from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
 from masters_thesis_tpu.serve.queue import (
+    DEFAULT_TENANT,
     STATUS_ERROR,
     STATUS_OK,
     STATUS_REJECTED_LATE,
@@ -411,6 +412,14 @@ class FleetServer:
                 "deaths": self.deaths,
                 "redispatched": self.redispatched,
             }
+        lanes = max(
+            (
+                getattr(r.engine, "num_lanes", 1)
+                for r in self.replicas.values()
+                if r.engine is not None
+            ),
+            default=1,
+        )
         return {
             "replicas": per_replica,
             "n_live": sum(
@@ -420,6 +429,8 @@ class FleetServer:
             "queue_wait_share": queue_wait_share,
             "compute_share": compute_share,
             "shed_by_reason": shed_by_reason,
+            "tenants": self.queue.tenant_stats(),
+            "lanes": lanes,
             "requests": self.queue.submitted,
             "shed": self.queue.shed,
             **counters,
@@ -431,7 +442,27 @@ class FleetServer:
 
     # -------------------------------------------------------------- request
 
-    def submit(self, x, deadline_s: float) -> PendingRequest:
+    def register_tenant(
+        self, name: str, deadline_s: float | None = None
+    ) -> None:
+        """Onboard (or re-class) a tenant fleet-wide; emits
+        ``tenant_admitted`` the first time this fleet sees it."""
+        _, created = self.queue.tenant(name, deadline_s)
+        if created:
+            self._event(
+                "tenant_admitted",
+                tenant=name,
+                deadline_ms=(
+                    None if deadline_s is None else deadline_s * 1e3
+                ),
+            )
+
+    def submit(
+        self,
+        x,
+        deadline_s: float | None = None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> PendingRequest:
         x = np.asarray(x, np.float32)
         if self._window_shape is None:
             raise RuntimeError("fleet not started")
@@ -440,6 +471,14 @@ class FleetServer:
                 f"request window shape {x.shape} != engine window shape "
                 f"{self._window_shape}"
             )
+        if deadline_s is None:
+            deadline_s = self.queue.tenant_deadline_s(tenant)
+            if deadline_s is None:
+                raise ValueError(
+                    f"request carries no deadline and tenant {tenant!r} "
+                    "has no deadline class (register_tenant first)"
+                )
+        self.register_tenant(tenant)
         with self._lock:
             self._rid += 1
             rid = self._rid
@@ -452,7 +491,8 @@ class FleetServer:
         )
         pending = self.queue.submit(
             ServeRequest(
-                rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s
+                rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s,
+                tenant=tenant,
             )
         )
         if not pending.done:
@@ -629,6 +669,10 @@ class FleetServer:
         with self._lock:
             replica.busy_s += device_s
         replica.service_model.update(device_s)
+        # Per-tenant EWMA: each tenant in this batch saw this service time.
+        self.queue.note_service(
+            {p.request.tenant for p in live}, device_s
+        )
         replica.breaker.record_success()
         self.restart_policy.note_healthy(replica.name)
         finite = bool(
@@ -669,9 +713,14 @@ class FleetServer:
                     self._count("late_deliveries")
         if self.quality is not None:
             # Strictly post-delivery, host-side numpy only (TL105/TA202
-            # and the serve preflight stay green by construction).
+            # and the serve preflight stay green by construction). Stacked
+            # engines deliver per-lane (R, K) outputs per window; the
+            # quality plane monitors the served ensemble mean.
             for i in delivered:
-                self.quality.sample(live[i].request.x, alpha[i], beta[i])
+                a_i, b_i = alpha[i], beta[i]
+                if a_i.ndim == 2:
+                    a_i, b_i = a_i.mean(axis=0), b_i.mean(axis=0)
+                self.quality.sample(live[i].request.x, a_i, b_i)
 
     # -------------------------------------------------------------- degrade
 

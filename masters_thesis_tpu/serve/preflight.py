@@ -31,6 +31,12 @@ window of varied batch sizes and asserts the serving contract:
   killed by an injected dispatch crash must keep >= 1 serving replica,
   resolve every in-flight request explicitly (ok / shed /
   rejected_late — zero silent drops), and deliver zero late answers.
+- **SV307** — stacked serving compiles ONE program per bucket regardless
+  of the lane count R, and the compiled HLO is structurally R-invariant
+  (the lane axis is scanned, never unrolled into R copies of the model).
+- **SV308** — a per-lane hot-swap under steady-state load causes zero
+  new compiles and zero late answers, and sibling lanes keep answering
+  bitwise-identically through the identical executable.
 
 Sized to run in seconds on the 8-device virtual CPU mesh; the invariants
 are properties of the compiled programs, not of the backend.
@@ -409,6 +415,191 @@ def _run_fleet(spec, n_replicas, buckets, requests) -> list[Finding]:
                 message=f"{stats['late_deliveries']} ok response(s) "
                 "delivered past their deadline during failover (the "
                 "no-late-answers invariant must hold fleet-wide)",
+            )
+        )
+    return findings
+
+
+def _hlo_fingerprint(text: str) -> dict:
+    """Structural fingerprint of a compiled program's HLO text.
+
+    The stacked predict program scans over the lane axis, so its compiled
+    shape must be R-invariant up to the lane-dim literals embedded in
+    shape annotations: same line count, same dot/while/fusion op counts.
+    Per-lane unrolling (a vmap-style batching regression, or a Python
+    loop over lanes leaking into the trace) scales these with R and
+    fails the comparison loudly.
+    """
+    lines = text.splitlines()
+    return {
+        "lines": len(lines),
+        "dots": sum(l.count(" dot(") + l.count("= dot(") for l in lines),
+        "whiles": sum("while(" in l or " while " in l for l in lines),
+        "fusions": sum("fusion(" in l for l in lines),
+    }
+
+
+def run_stacked_preflight(
+    spec=None,
+    mesh=None,
+    buckets=(1, 2),
+    lane_counts=(2, 4),
+    requests: int = 12,
+) -> list[Finding]:
+    """SV307/SV308 — multi-tenant stacked serving contract.
+
+    - **SV307** — one program per bucket regardless of R: a stacked
+      engine's warmup compiles exactly ``len(buckets)`` executables at
+      EVERY lane count, and the compiled HLO is structurally R-invariant
+      (no per-lane unrolling — lane count is a data dimension, never a
+      program dimension).
+    - **SV308** — lane hot-swap under steady-state load: swapping one
+      lane's params mid-window causes ZERO new compiles and ZERO late
+      answers; sibling lanes keep answering bitwise-identically.
+    """
+    try:
+        return _run_stacked(spec, mesh, buckets, lane_counts, requests)
+    except Exception as exc:  # noqa: BLE001 — SV303 carries the cause
+        return [
+            Finding(
+                rule="SV303",
+                message=f"stacked preflight could not run: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+
+
+def _run_stacked(spec, mesh, buckets, lane_counts, requests) -> list[Finding]:
+    import jax
+
+    from masters_thesis_tpu.serve.server import PredictServer
+    from masters_thesis_tpu.serve.stacked import StackedPredictEngine
+
+    findings: list[Finding] = []
+    spec = spec or _preflight_spec()
+    lane_counts = tuple(sorted(set(int(r) for r in lane_counts)))
+    max_r = max(lane_counts)
+
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    dummy = jnp.zeros(
+        (1, PREFLIGHT_LOOKBACK, PREFLIGHT_FEATURES), jnp.float32
+    )
+    params = [
+        module.init(jax.random.key(seed), dummy)["params"]
+        for seed in range(max_r + 1)
+    ]
+
+    def build(r):
+        return StackedPredictEngine(
+            spec, params[:r],
+            n_stocks=PREFLIGHT_STOCKS,
+            lookback=PREFLIGHT_LOOKBACK,
+            n_features=PREFLIGHT_FEATURES,
+            buckets=buckets,
+            mesh=mesh,
+        )
+
+    # SV307 — compile accounting + HLO shape across lane counts.
+    fingerprints: dict[int, dict[int, dict]] = {}
+    engines: dict[int, StackedPredictEngine] = {}
+    for r in lane_counts:
+        eng = build(r)
+        eng.warmup()
+        engines[r] = eng
+        if eng.compile_events != len(eng.buckets):
+            findings.append(
+                Finding(
+                    rule="SV307",
+                    message=f"R={r}: warmup compiled {eng.compile_events} "
+                    f"executables for {len(eng.buckets)} buckets "
+                    f"{eng.buckets} (expected exactly one per bucket — "
+                    "lane count must not multiply programs)",
+                )
+            )
+        fingerprints[r] = {
+            b: _hlo_fingerprint(eng.compiled_text(b)) for b in eng.buckets
+        }
+    base_r = lane_counts[0]
+    for r in lane_counts[1:]:
+        for b in fingerprints[base_r]:
+            if fingerprints[r].get(b) != fingerprints[base_r][b]:
+                findings.append(
+                    Finding(
+                        rule="SV307",
+                        message=f"bucket {b}: compiled HLO shape changed "
+                        f"with lane count (R={base_r}: "
+                        f"{fingerprints[base_r][b]} vs R={r}: "
+                        f"{fingerprints[r][b]}) — the stacked program is "
+                        "unrolling per lane instead of scanning the lane "
+                        "axis",
+                    )
+                )
+
+    # SV308 — lane swap under a live serving window.
+    eng = engines[max_r]
+    server = PredictServer(eng, max_wait_s=0.003)
+    rng = np.random.default_rng(0)
+    k, t, f = eng.window_shape
+    swap_lane = max_r - 1
+    gx = eng.golden_batch(min(2, eng.max_bucket), seed=5)
+    pre_a, pre_b = eng.predict(gx)
+    try:
+        server.start()
+        baseline = eng.compile_events
+        pendings = []
+        for i in range(requests):
+            if i == requests // 2:
+                eng.set_lane(swap_lane, params[max_r])
+            pendings.append(
+                server.submit(
+                    rng.standard_normal((k, t, f)).astype(np.float32),
+                    deadline_s=2.0,
+                )
+            )
+        for p in pendings:
+            p.result(timeout=10.0)
+        stats = server.stop()
+    except Exception:
+        server.stop()
+        raise
+    delta = eng.compile_events - baseline
+    if delta:
+        findings.append(
+            Finding(
+                rule="SV308",
+                message=f"lane hot-swap compiled {delta} new "
+                "executable(s) — a lane swap is a row write into the "
+                "stacked buffers and must never retrace",
+            )
+        )
+    if stats["late_deliveries"]:
+        findings.append(
+            Finding(
+                rule="SV308",
+                message=f"{stats['late_deliveries']} ok response(s) "
+                "delivered past their deadline across the lane swap "
+                "(the no-late-answers invariant must hold through "
+                "per-lane swaps)",
+            )
+        )
+    post_a, post_b = eng.predict(gx)
+    sibling_moved = [
+        r for r in range(max_r)
+        if r != swap_lane
+        and not (
+            np.array_equal(pre_a[:, r, :], post_a[:, r, :])
+            and np.array_equal(pre_b[:, r, :], post_b[:, r, :])
+        )
+    ]
+    if sibling_moved:
+        findings.append(
+            Finding(
+                rule="SV308",
+                message=f"lane swap on lane {swap_lane} moved sibling "
+                f"lane(s) {sibling_moved} — per-lane isolation must be "
+                "bitwise through the identical executable",
             )
         )
     return findings

@@ -13,7 +13,14 @@ any backend:
   the caller and steals capacity from every request behind it;
 - the server converts any response that would still be delivered past its
   deadline into an explicit rejection (server.py): the engine never
-  returns a late answer as if it were good.
+  returns a late answer as if it were good;
+- admission is TENANT-aware: every request bills to a tenant
+  (:class:`TenantClass`), each tenant carries its own deadline class (the
+  default budget for its requests), its own shed accounting, and its own
+  EWMA service model — once a tenant has been served at least one batch,
+  its admission forecasts use its own measured rate instead of the
+  queue-wide aggregate, so one tenant's pathological traffic cannot
+  silently distort another's admission decisions.
 
 Fault point ``serve.admit`` (kind ``wedge``) forces a shed at submit time,
 so the chaos suite can drive deterministic overload decisions without
@@ -44,6 +51,12 @@ STATUS_REJECTED_LATE = "rejected_late"
 STATUS_ERROR = "error"
 
 
+#: Tenant assigned to requests that don't declare one. Single-tenant
+#: deployments never see tenancy — the default tenant is auto-registered
+#: and all accounting folds into it.
+DEFAULT_TENANT = "default"
+
+
 @dataclass
 class ServeRequest:
     """One predict request: a single window ``x`` of shape (K, T, F) plus
@@ -53,6 +66,10 @@ class ServeRequest:
     x: Any  # np.ndarray (K, T, F); typed Any to keep this module jax/np-light
     deadline_ts: float
     submitted_ts: float = field(default_factory=time.monotonic)
+    #: Logical tenant this request bills to (stacked serving: typically
+    #: the lane owner). Pure accounting/admission metadata — dispatch
+    #: fans every request across all lanes regardless.
+    tenant: str = DEFAULT_TENANT
 
 
 @dataclass
@@ -134,6 +151,41 @@ class ServiceTimeModel:
         return (batches_ahead + 1) * self.batch_s
 
 
+@dataclass
+class TenantClass:
+    """Admission policy + accounting for one tenant (jax-free).
+
+    ``deadline_s`` is the tenant's deadline CLASS: the default budget
+    stamped on its requests when the caller doesn't carry an explicit
+    one (an interactive tenant rides a tight class, a batch tenant a
+    loose one). The per-tenant :class:`ServiceTimeModel` tracks the
+    service rate THIS tenant's batches actually see — seeded from the
+    queue-wide model at registration, updated only by this tenant's
+    dispatches — so per-tenant admission forecasts stay honest even when
+    tenants' deadline classes differ by orders of magnitude.
+    """
+
+    name: str
+    deadline_s: float | None = None
+    model: ServiceTimeModel = field(default_factory=ServiceTimeModel)
+    admitted: int = 0
+    shed: int = 0
+    #: Batches this tenant has actually been served in. Until the first
+    #: one, admission falls back to the queue-wide model — a freshly
+    #: onboarded tenant must not forecast from an unseeded EWMA.
+    observed: int = 0
+
+    def stats(self) -> dict:
+        return {
+            "admitted": self.admitted,
+            "shed": self.shed,
+            "deadline_ms": (
+                None if self.deadline_s is None else self.deadline_s * 1e3
+            ),
+            "batch_ms": self.model.batch_s * 1e3,
+        }
+
+
 class MicroBatchQueue:
     """Bounded FIFO with deadline admission and max-wait/max-batch firing."""
 
@@ -166,10 +218,68 @@ class MicroBatchQueue:
         self._closed = False
         self.submitted = 0
         self.shed = 0
+        #: Per-tenant admission state, keyed by tenant name. The default
+        #: tenant always exists so single-tenant callers never special-case.
+        self._tenants: dict[str, TenantClass] = {}
+        self.tenant(DEFAULT_TENANT)
 
     def __len__(self) -> int:
         with self._cond:
             return len(self._items)
+
+    # ------------------------------------------------------------- tenancy
+
+    def tenant(
+        self, name: str, deadline_s: float | None = None
+    ) -> tuple[TenantClass, bool]:
+        """Look up (auto-registering) a tenant; returns ``(class, created)``.
+
+        A new tenant's EWMA seeds from the queue-wide model's CURRENT
+        estimate so its first forecast reflects the engine warmup timing
+        rather than the class default. ``deadline_s`` (re)pins the
+        tenant's deadline class when given.
+        """
+        with self._cond:
+            t = self._tenants.get(name)
+            if t is None:
+                t = TenantClass(
+                    name=name,
+                    deadline_s=deadline_s,
+                    model=ServiceTimeModel(
+                        initial_s=self.service_model.batch_s
+                    ),
+                )
+                self._tenants[name] = t
+                return t, True
+            if deadline_s is not None:
+                t.deadline_s = deadline_s
+            return t, False
+
+    def tenant_deadline_s(self, name: str) -> float | None:
+        """The tenant's deadline class (None when it never declared one)."""
+        with self._cond:
+            t = self._tenants.get(name)
+            return t.deadline_s if t is not None else None
+
+    def note_service(self, tenants, batch_s: float) -> None:
+        """Fold one measured batch service time into each named tenant's
+        EWMA (called by the dispatch loop after compute)."""
+        with self._cond:
+            ts = [
+                self._tenants[n] for n in set(tenants) if n in self._tenants
+            ]
+            for t in ts:
+                t.observed += 1
+        for t in ts:  # EWMA has its own lock; keep it out of _cond
+            t.model.update(batch_s)
+
+    def tenant_stats(self) -> dict:
+        """``{tenant: {admitted, shed, deadline_ms, batch_ms}}`` snapshot."""
+        with self._cond:
+            return {
+                name: t.stats()
+                for name, t in sorted(self._tenants.items())
+            }
 
     def _shed(self, pending: PendingRequest, reason: str) -> PendingRequest:
         # Only the counter bump takes the lock: resolving the pending and
@@ -178,6 +288,9 @@ class MicroBatchQueue:
         # a lock-order inversion against the dispatch path).
         with self._cond:
             self.shed += 1
+            t = self._tenants.get(pending.request.tenant)
+            if t is not None:
+                t.shed += 1
         now = time.monotonic()
         pending.resolve(
             ServeResponse(
@@ -197,6 +310,7 @@ class MicroBatchQueue:
         already resolved). Never blocks on capacity — backpressure is an
         explicit rejection, not a stalled caller."""
         pending = PendingRequest(request)
+        tenant, _ = self.tenant(request.tenant)
         with self._cond:
             self.submitted += 1
             depth = len(self._items)
@@ -221,9 +335,13 @@ class MicroBatchQueue:
             if reason is not None:
                 return self._shed(pending, reason)
         else:
-            est = self.service_model.estimate_completion_s(
-                depth, self.max_batch
+            # Forecast with the tenant's OWN service model once it has
+            # seen a batch (its requests may systematically differ from
+            # the aggregate); a fresh tenant uses the queue-wide EWMA.
+            model = (
+                tenant.model if tenant.observed > 0 else self.service_model
             )
+            est = model.estimate_completion_s(depth, self.max_batch)
             now = time.monotonic()
             if now + est > request.deadline_ts:
                 budget_ms = (request.deadline_ts - now) * 1e3
@@ -237,6 +355,7 @@ class MicroBatchQueue:
                 pass
             else:
                 self._items.append(pending)
+                tenant.admitted += 1
                 self._cond.notify_all()
                 return pending
         return self._shed(pending, "server shutting down")

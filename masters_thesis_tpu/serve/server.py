@@ -36,6 +36,7 @@ import numpy as np
 
 from masters_thesis_tpu.resilience import faults
 from masters_thesis_tpu.serve.queue import (
+    DEFAULT_TENANT,
     STATUS_ERROR,
     STATUS_OK,
     STATUS_REJECTED_LATE,
@@ -262,6 +263,8 @@ class PredictServer:
             "queue_wait_share": queue_wait_share,
             "compute_share": compute_share,
             "shed_by_reason": shed_by_reason,
+            "tenants": self.queue.tenant_stats(),
+            "lanes": getattr(self.engine, "num_lanes", 1),
             "requests": self.queue.submitted,
             "completed": self.completed,
             "shed": self.queue.shed,
@@ -277,14 +280,49 @@ class PredictServer:
 
     # -------------------------------------------------------------- request
 
-    def submit(self, x, deadline_s: float) -> PendingRequest:
-        """Admit one window with a relative deadline budget in seconds."""
+    def register_tenant(
+        self, name: str, deadline_s: float | None = None
+    ) -> None:
+        """Onboard (or re-class) a tenant: pins its deadline class on the
+        queue and emits ``tenant_admitted`` the first time the serving
+        plane sees it — the operator-visible onboarding record."""
+        _, created = self.queue.tenant(name, deadline_s)
+        if created:
+            self._event(
+                "tenant_admitted",
+                tenant=name,
+                deadline_ms=(
+                    None if deadline_s is None else deadline_s * 1e3
+                ),
+            )
+
+    def submit(
+        self,
+        x,
+        deadline_s: float | None = None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> PendingRequest:
+        """Admit one window with a relative deadline budget in seconds.
+
+        ``deadline_s=None`` falls back to ``tenant``'s deadline class
+        (register_tenant); a request with neither is a caller bug.
+        An unregistered tenant is onboarded on first submit (with the
+        ``tenant_admitted`` event) so accounting never drops requests.
+        """
         x = np.asarray(x, np.float32)
         if x.shape != tuple(self.engine.window_shape):
             raise ValueError(
                 f"request window shape {x.shape} != engine window shape "
                 f"{tuple(self.engine.window_shape)}"
             )
+        if deadline_s is None:
+            deadline_s = self.queue.tenant_deadline_s(tenant)
+            if deadline_s is None:
+                raise ValueError(
+                    f"request carries no deadline and tenant {tenant!r} "
+                    "has no deadline class (register_tenant first)"
+                )
+        self.register_tenant(tenant)
         with self._rid_lock:
             self._rid += 1
             rid = self._rid
@@ -297,7 +335,8 @@ class PredictServer:
         )
         pending = self.queue.submit(
             ServeRequest(
-                rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s
+                rid=rid, x=x, deadline_ts=time.monotonic() + deadline_s,
+                tenant=tenant,
             )
         )
         if not pending.done:
@@ -408,6 +447,10 @@ class PredictServer:
                 n=len(live),
             )
         self.service_model.update(device_s)
+        # Per-tenant EWMA: each tenant in this batch saw this service time.
+        self.queue.note_service(
+            {p.request.tenant for p in live}, device_s
+        )
         self.breaker.record_success()
         finite = bool(
             np.isfinite(alpha).all() and np.isfinite(beta).all()
@@ -445,8 +488,14 @@ class PredictServer:
             # Strictly post-delivery: every sampled response has already
             # been resolved to its caller, and alpha/beta/x are host
             # numpy — zero new fences or transfers on the hot path.
+            # Stacked engines deliver per-lane (R, K) outputs per window;
+            # the quality plane monitors THE served answer, which for an
+            # ensemble is its mean across lanes.
             for i in delivered:
-                self.quality.sample(live[i].request.x, alpha[i], beta[i])
+                a_i, b_i = alpha[i], beta[i]
+                if a_i.ndim == 2:
+                    a_i, b_i = a_i.mean(axis=0), b_i.mean(axis=0)
+                self.quality.sample(live[i].request.x, a_i, b_i)
 
     # ----------------------------------------------------------- degrade
 

@@ -1,0 +1,602 @@
+"""Multi-tenant stacked inference: R checkpoints, ONE program per bucket.
+
+The stacked trainer (train/steps.py:make_stacked_train_epoch) proved the
+lane-stacking economics on this hardware: R independent replicas run as
+one compiled program at ~R× cells/hour because compile, dispatch, and
+collective launches amortize across the stack. This module spends the
+same insight on the serving plane. ``StackedPredictEngine`` loads R
+manifest-verified checkpoints (ensemble members, grid winners,
+per-universe/per-tenant models) into the flat ``[R, n]`` per-dtype
+buffers from :mod:`~masters_thesis_tpu.train.flatparams` and AOT-compiles
+ONE predict executable per batch bucket — a request fans across all R
+lanes in a single dispatch, at roughly one model's dispatch cost.
+
+Layout of the lane axis — a rolled ``lax.scan``, not ``vmap``:
+
+- ``vmap`` over the param axis batches every lane matmul into one
+  ``dot_general`` with a leading batch dim; XLA:CPU reassociates those
+  reductions differently from the unbatched kernel, and per-lane outputs
+  drift from the solo engine at the ULP level (measured ~6e-8 — the same
+  effect docs/perf.md records for the stacked TRAINER, where it is
+  tolerated). Serving has a harder contract: a tenant's answers must be
+  **bit-identical** to the solo engine serving the same checkpoint, or a
+  migration onto the stack is observable (and un-debuggable) downstream.
+- A rolled ``lax.scan`` over the ``[R, n]`` buffers runs each lane
+  through literally the same op sequence as the solo engine — bitwise
+  parity, pinned per bucket by tests/test_stacked_serve.py — while still
+  compiling to ONE executable per bucket whose HLO does not grow with R
+  (the loop stays rolled; preflight rule SV307 pins this on the compiled
+  HLO, the serving twin of TA207).
+
+Per-lane hot-swap (serve/swap.py:try_swap_lane) commits through
+:meth:`StackedPredictEngine.set_lane`: one row-scatter over the stacked
+buffers under the engine lock. Shapes never change, so the swap performs
+ZERO recompiles (SV308); sibling lanes' rows — and therefore their
+outputs — are bit-untouched.
+
+Program-cache identity: the stacked executable's entry key covers the
+ORDERED per-lane content digests (:func:`lane_digest`) on top of the
+usual spec/window/bucket/backend identity. A lane swap therefore misses
+the cache for the stack on the next boot (the golden record stored with
+the entry replays the old lane's outputs — content must be part of the
+key for parity to mean anything) while every unchanged SOLO program
+still hits: solo keys never see lane digests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from masters_thesis_tpu.models.objectives import ModelSpec
+from masters_thesis_tpu.parallel import (
+    DATA_AXIS,
+    global_put,
+    make_data_mesh,
+    replicated_sharding,
+)
+from masters_thesis_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    BucketOverflowError,
+)
+from masters_thesis_tpu.train import flatparams
+from masters_thesis_tpu.train.steps import forward_rows
+
+
+def lane_digest(host_bufs: dict) -> str:
+    """Content hash of one lane's flat buffers (host-side, order-stable).
+
+    Part of the stacked program-cache identity: unlike the solo engine —
+    whose executable is param-CONTENT-independent, so its key only needs
+    the leaf signature — the stacked entry's golden record replays every
+    lane's stored outputs, so the key must pin which checkpoints occupy
+    which lanes.
+    """
+    h = hashlib.sha256()
+    for key in sorted(host_bufs):
+        arr = np.ascontiguousarray(np.asarray(host_bufs[key]))
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def ensemble_stats(alpha: np.ndarray, beta: np.ndarray) -> dict:
+    """Ensemble mean + uncertainty bands over per-lane outputs.
+
+    ``alpha``/``beta`` are the engine's batch-major per-lane arrays
+    ``(n, R, K)``; returns host f64 arrays shaped ``(n, K)``:
+    ``{alpha,beta}_mean``, ``_std`` (population std across lanes — the
+    band half-width), and ``_lo``/``_hi`` (the lane envelope). f64 on
+    purpose: the reduction is host-side statistics over R samples and
+    must not add f32 rounding of its own.
+    """
+    out: dict[str, np.ndarray] = {}
+    for name, v in (("alpha", alpha), ("beta", beta)):
+        a = np.asarray(v, np.float64)  # mtt: disable=TL104 -- host-only ensemble statistics; never traced
+        if a.ndim != 3:
+            raise ValueError(
+                f"{name} must be (n, R, K) per-lane outputs, got {a.shape}"
+            )
+        out[f"{name}_mean"] = a.mean(axis=1)
+        out[f"{name}_std"] = a.std(axis=1)
+        out[f"{name}_lo"] = a.min(axis=1)
+        out[f"{name}_hi"] = a.max(axis=1)
+    return out
+
+
+class LaneMismatchError(ValueError):
+    """Candidate lane params do not match the stack's shared signature."""
+
+
+class StackedPredictEngine:
+    """Bucketed AOT predict programs over R stacked model lanes.
+
+    ``predict`` maps a host batch ``x (n, K, T, F)`` to BATCH-MAJOR
+    per-lane outputs ``(alpha (n, R, K), beta (n, R, K))`` — batch axis
+    first so the server/fleet dispatch loops index per-request outputs
+    exactly as they do for the solo engine (``alpha[i]`` is request i's
+    ``(R, K)`` fan-out). :func:`ensemble_stats` folds the lane axis into
+    mean/bands for callers that want one answer with uncertainty.
+
+    API contract shared with :class:`~masters_thesis_tpu.serve.engine
+    .PredictEngine` (what server.py/fleet.py/preflight rely on):
+    ``window_shape``, ``max_bucket``, ``platform``, ``buckets``,
+    ``compile_events``/``_cache_size``, ``cache_hits``, ``cost_profiles``,
+    ``warmup()``, ``bucket_for``, ``predict``, ``golden_batch``,
+    ``degrade_to_cpu``.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        params_list: Sequence[Any],
+        *,
+        n_stocks: int,
+        lookback: int,
+        n_features: int = 3,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        mesh: Mesh | None = None,
+        program_cache=None,
+        lanes: Sequence[str] | None = None,
+    ):
+        if not params_list:
+            raise ValueError("need at least one lane (R >= 1)")
+        self.spec = spec
+        self.n_stocks = n_stocks
+        self.lookback = lookback
+        self.n_features = n_features
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"invalid buckets: {buckets!r}")
+        self.mesh = mesh if mesh is not None else make_data_mesh(None)
+        self._module = spec.build_module()
+        self.num_lanes = len(params_list)
+        #: Lane names (tenant ids / checkpoint tags); purely descriptive.
+        self.lanes = (
+            tuple(str(x) for x in lanes)
+            if lanes is not None
+            else tuple(f"lane{i}" for i in range(self.num_lanes))
+        )
+        if len(self.lanes) != self.num_lanes:
+            raise ValueError(
+                f"{len(self.lanes)} lane names for {self.num_lanes} lanes"
+            )
+        #: Monotonic count of XLA compilations (same contract as the solo
+        #: engine: constant after warmup(); SV307/SV308 pin the deltas).
+        self.compile_events = 0
+        self.cache_hits = 0
+        self.program_cache = program_cache
+        self._compiled: dict[int, tuple[Any, NamedSharding]] = {}
+        self.cost_profiles: dict[int, dict] = {}
+        self._lock = threading.RLock()
+        # One shared view table for every lane: the stack is only sound if
+        # all R trees carve identically.
+        host_trees = [jax.device_get(p) for p in params_list]
+        self._fspec = flatparams.flatten_spec(host_trees[0])
+        sig0 = self._solo_signature(host_trees[0])
+        for i, tree in enumerate(host_trees[1:], start=1):
+            if self._solo_signature(tree) != sig0:
+                raise LaneMismatchError(
+                    f"lane {i} ({self.lanes[i]}) param tree does not match "
+                    "lane 0 — stacked serving requires identical "
+                    "architectures across lanes"
+                )
+        self._solo_sig = sig0
+        host_flat = [
+            flatparams.flatten(t, self._fspec) for t in host_trees
+        ]
+        self._lane_digests = [lane_digest(b) for b in host_flat]
+        self._stacked = global_put(
+            {
+                k: np.stack([np.asarray(b[k]) for b in host_flat])
+                for k in host_flat[0]
+            },
+            replicated_sharding(self.mesh),
+        )
+
+    @staticmethod
+    def _solo_signature(host_tree: Any) -> tuple:
+        leaves, treedef = jax.tree_util.tree_flatten(host_tree)
+        return (
+            str(treedef),
+            tuple(
+                (tuple(np.shape(x)), str(np.asarray(x).dtype))
+                for x in leaves
+            ),
+        )
+
+    # jit_cache_size()/CompileTracker compatibility.
+    def _cache_size(self) -> int:
+        return self.compile_events
+
+    @property
+    def window_shape(self) -> tuple[int, int, int]:
+        return (self.n_stocks, self.lookback, self.n_features)
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def platform(self) -> str:
+        devs = list(self.mesh.devices.flat)
+        return devs[0].platform if devs else jax.default_backend()
+
+    def _predict_fn(self, stacked, x):
+        # Rolled scan over the lane axis: each iteration is the solo
+        # engine's exact op sequence (unflatten is views-only; forward is
+        # the unbatched kernel), so per-lane outputs are bit-identical to
+        # R solo engines while the whole fan-out stays one executable.
+        def lane_step(carry, lane_bufs):
+            params = flatparams.unflatten(lane_bufs, self._fspec)
+            alpha, beta = forward_rows(self._module, params, x)
+            return carry, (alpha[..., 0], beta[..., 0])
+
+        _, (alpha, beta) = lax.scan(lane_step, None, stacked)
+        # (R, n, K) -> batch-major (n, R, K) so dispatch loops can index
+        # request i's outputs as alpha[i] exactly like the solo engine.
+        return jnp.moveaxis(alpha, 0, 1), jnp.moveaxis(beta, 0, 1)
+
+    # ------------------------------------------------- program-cache glue
+
+    def _cache_identity(self, b: int) -> tuple[str, dict]:
+        """(entry key, backend fingerprint) for bucket ``b``'s program.
+
+        On top of the solo identity (spec / signature / window / bucket /
+        backend), the stacked key pins the ORDERED per-lane content
+        digests: a lane swap re-keys the stack (its stored golden replay
+        embodies the old lane's outputs) while unchanged solo entries —
+        whose keys never include lane digests — keep hitting.
+        """
+        import dataclasses
+
+        from masters_thesis_tpu.serve import program_cache as pc
+        from masters_thesis_tpu.utils.backend_probe import backend_fingerprint
+
+        fp = backend_fingerprint(self.mesh)
+        ident = {
+            "spec": dataclasses.asdict(self.spec),
+            "params": pc.param_signature(self._stacked),
+            "lanes": list(self._lane_digests),
+            "window": list(self.window_shape),
+            "bucket": int(b),
+            "fingerprint": fp,
+        }
+        return pc.entry_key(ident), fp
+
+    def _golden_x(self, b: int) -> np.ndarray:
+        # Seed offset vs the solo engine so a stacked and a solo entry for
+        # the same checkpoint never share golden inputs by accident.
+        return self.golden_batch(n=b, seed=2003 * b + 11)
+
+    def _cache_load(self, b: int, x_sh: NamedSharding, repl: NamedSharding):
+        """Try to boot bucket ``b`` from the program cache (None = miss)."""
+        key, fp = self._cache_identity(b)
+        treedef = jax.tree_util.tree_structure(self._stacked)
+        in_tree = jax.tree_util.tree_structure(((self._stacked, 0), {}))
+        out_tree = jax.tree_util.tree_structure((0, 0))
+
+        def run_golden(compiled, golden):
+            n_leaves = sum(1 for k2 in golden if k2.startswith("param_"))
+            leaves = [golden[f"param_{i}"] for i in range(n_leaves)]
+            stree = jax.tree_util.tree_unflatten(treedef, leaves)
+            sd = global_put(stree, repl)
+            xd = jax.device_put(np.ascontiguousarray(golden["x"]), x_sh)
+            alpha, beta = compiled(sd, xd)
+            return (
+                np.asarray(jax.device_get(alpha)),
+                np.asarray(jax.device_get(beta)),
+            )
+
+        return self.program_cache.load(
+            key,
+            fingerprint=fp,
+            in_tree=in_tree,
+            out_tree=out_tree,
+            run_golden=run_golden,
+        )
+
+    def _cache_store(self, b: int, compiled, x_sh: NamedSharding) -> None:
+        key, fp = self._cache_identity(b)
+        x = self._golden_x(b)
+        xd = jax.device_put(np.ascontiguousarray(x), x_sh)
+        alpha, beta = compiled(self._stacked, xd)
+        host_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(self._stacked)
+        )
+        golden = {
+            "x": x,
+            "alpha": np.asarray(jax.device_get(alpha)),
+            "beta": np.asarray(jax.device_get(beta)),
+        }
+        for i, leaf in enumerate(host_leaves):
+            golden[f"param_{i}"] = np.asarray(leaf)
+        self.program_cache.store(key, compiled, fingerprint=fp, golden=golden)
+
+    # ------------------------------------------------------------ compile
+
+    def _compile_bucket(self, b: int) -> None:
+        k, t, f = self.window_shape
+        repl = replicated_sharding(self.mesh)
+        if b % self.mesh.size == 0:
+            x_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        else:
+            x_sh = repl
+        compiled = None
+        if self.program_cache is not None:
+            compiled = self._cache_load(b, x_sh, repl)
+        if compiled is not None:
+            self.cache_hits += 1
+        else:
+            jfn = jax.jit(
+                self._predict_fn,
+                in_shardings=(repl, x_sh),
+                out_shardings=(repl, repl),
+            )
+            x_struct = jax.ShapeDtypeStruct((b, k, t, f), jnp.float32)
+            compiled = jfn.lower(self._stacked, x_struct).compile()
+            self.compile_events += 1
+            if self.program_cache is not None:
+                self._cache_store(b, compiled, x_sh)
+        self._compiled[b] = (compiled, x_sh)
+        try:
+            from masters_thesis_tpu.telemetry.costs import extract_cost
+
+            self.cost_profiles[b] = extract_cost(
+                compiled,
+                program=f"serve_stacked_bucket_{b}",
+                meta={
+                    "bucket": b,
+                    "lanes": self.num_lanes,
+                    "platform": self.platform,
+                    "mesh_size": self.mesh.size,
+                },
+            ).to_payload()
+        except Exception:  # cost accounting must never block serving
+            self.cost_profiles.pop(b, None)
+
+    def compiled_text(self, b: int) -> str:
+        """Compiled HLO for bucket ``b`` (preflight rule SV307 asserts the
+        lane loop stayed rolled — the module must not grow with R)."""
+        compiled, _ = self._compiled[b]
+        return compiled.as_text()
+
+    def warmup(self) -> float:
+        """Compile every bucket; return one max-bucket execution's wall
+        seconds (seeds the queue's service-time model, same as solo)."""
+        for b in self.buckets:
+            if b not in self._compiled:
+                self._compile_bucket(b)
+        k, t, f = self.window_shape
+        x = np.zeros((self.max_bucket, k, t, f), np.float32)
+        self.predict(x)
+        t0 = time.perf_counter()
+        self.predict(x)
+        return time.perf_counter() - t0
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise BucketOverflowError(
+            f"batch of {n} exceeds largest compiled bucket "
+            f"{self.max_bucket} (buckets: {self.buckets})"
+        )
+
+    # ------------------------------------------------------------ predict
+
+    def predict(
+        self, x: np.ndarray, params: Any = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One padded micro-batch through the bucket's AOT executable.
+
+        Returns batch-major per-lane ``(alpha (n, R, K), beta (n, R, K))``
+        host arrays. ``params`` overrides the serving STACK for this call
+        only (the per-lane canary path stages a candidate stack without
+        exposing it to traffic). Only explicit transfers.
+        """
+        x = np.asarray(x, np.float32)
+        if x.ndim != 4 or x.shape[1:] != self.window_shape:
+            raise ValueError(
+                f"request shape {x.shape} != (n, {self.n_stocks}, "
+                f"{self.lookback}, {self.n_features})"
+            )
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if n < b:
+            pad = np.broadcast_to(x[:1], (b - n,) + x.shape[1:])
+            x = np.concatenate([x, pad], axis=0)
+        compiled, x_sh = self._compiled[b]
+        xd = jax.device_put(np.ascontiguousarray(x), x_sh)
+        with self._lock:
+            s = self._stacked if params is None else params
+        alpha, beta = compiled(s, xd)
+        return (
+            np.asarray(jax.device_get(alpha))[:n],
+            np.asarray(jax.device_get(beta))[:n],
+        )
+
+    def predict_lane(
+        self, x: np.ndarray, lane: int, params: Any = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One lane's slice of :meth:`predict`: ``(alpha (n, K), beta
+        (n, K))`` — the solo-engine view of lane ``lane``."""
+        self._check_lane(lane)
+        alpha, beta = self.predict(x, params=params)
+        return alpha[:, lane, :], beta[:, lane, :]
+
+    def predict_ensemble(self, x: np.ndarray) -> dict:
+        """Per-lane outputs plus ensemble mean/bands in one dispatch."""
+        alpha, beta = self.predict(x)
+        out = ensemble_stats(alpha, beta)
+        out["alpha"] = alpha
+        out["beta"] = beta
+        return out
+
+    def golden_batch(self, n: int = 1, seed: int = 0) -> np.ndarray:
+        k, t, f = self.window_shape
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal((n, k, t, f)).astype(np.float32)
+
+    # -------------------------------------------------------------- lanes
+
+    def _check_lane(self, lane: int) -> None:
+        if not 0 <= int(lane) < self.num_lanes:
+            raise IndexError(
+                f"lane {lane} out of range (stack has {self.num_lanes})"
+            )
+
+    def lane_params(self, lane: int) -> Any:
+        """Host param tree currently serving on lane ``lane``."""
+        self._check_lane(lane)
+        host = jax.device_get(self._stacked)
+        return flatparams.unflatten(
+            flatparams.replica_flat(host, int(lane)), self._fspec
+        )
+
+    def lane_digests(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(self._lane_digests)
+
+    def stage_lane(self, lane: int, host_params: Any) -> Any:
+        """Candidate stack with lane ``lane`` replaced (does NOT commit).
+
+        The canary path runs this staged stack through the SAME compiled
+        executables as live traffic (``predict(..., params=staged)``) —
+        sibling rows are bit-identical to the serving stack, so any
+        sibling output movement is a lane-isolation bug, not noise.
+        """
+        self._check_lane(lane)
+        if self._solo_signature(jax.device_get(host_params)) != self._solo_sig:
+            raise LaneMismatchError(
+                "candidate lane params do not match the stack's shared "
+                "architecture (per-lane swap cannot change shapes — the "
+                "AOT executables are shape-specialized)"
+            )
+        bufs = flatparams.flatten(
+            jax.device_get(host_params), self._fspec
+        )
+        with self._lock:
+            staged = flatparams.set_lane(self._stacked, int(lane), bufs)
+        return global_put(
+            jax.device_get(staged), replicated_sharding(self.mesh)
+        )
+
+    def set_lane(self, lane: int, host_params: Any, staged: Any = None
+                 ) -> str:
+        """Atomically commit lane ``lane``'s params; returns the lane's
+        NEW content digest. ``staged`` (from :meth:`stage_lane`) skips
+        rebuilding the stack when the canary already staged it. Zero
+        recompiles by construction — shapes never change."""
+        self._check_lane(lane)
+        host = jax.device_get(host_params)
+        if self._solo_signature(host) != self._solo_sig:
+            raise LaneMismatchError(
+                "candidate lane params do not match the stack's shared "
+                "architecture"
+            )
+        bufs = flatparams.flatten(host, self._fspec)
+        digest = lane_digest(jax.device_get(bufs))
+        with self._lock:
+            if staged is None:
+                staged = global_put(
+                    jax.device_get(
+                        flatparams.set_lane(self._stacked, int(lane), bufs)
+                    ),
+                    replicated_sharding(self.mesh),
+                )
+            self._stacked = staged
+            self._lane_digests[int(lane)] = digest
+        return digest
+
+    # -------------------------------------------------------- degradation
+
+    def degrade_to_cpu(self) -> None:
+        """Rebuild mesh + executables on the CPU backend (breaker policy);
+        one deliberate compile burst, same contract as the solo engine."""
+        from masters_thesis_tpu.utils.backend_probe import pin_cpu_in_process
+
+        host_stacked = jax.device_get(self._stacked)
+        pin_cpu_in_process()
+        cpu = jax.devices("cpu")
+        with self._lock:
+            self.mesh = Mesh(np.asarray(cpu[:1]), axis_names=(DATA_AXIS,))
+            self._stacked = global_put(
+                host_stacked, replicated_sharding(self.mesh)
+            )
+            self._compiled.clear()
+            self.cost_profiles.clear()
+            for b in self.buckets:
+                self._compile_bucket(b)  # mtt: disable=CL503 -- CPU-degrade failover must swap stack+programs atomically; callers accept the pause
+
+    # -------------------------------------------------------------- boot
+
+    @classmethod
+    def from_checkpoints(
+        cls,
+        ckpt_dirs: Sequence[Any],
+        tag: str = "best",
+        *,
+        n_stocks: int,
+        n_features: int = 3,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        mesh: Mesh | None = None,
+        program_cache=None,
+        lanes: Sequence[str] | None = None,
+    ) -> "StackedPredictEngine":
+        """Boot a stack from R published checkpoints, STRICT verification
+        per lane: every lane's tree must prove itself against its own
+        manifest — one unprovable tenant must not board the stack."""
+        from pathlib import Path
+
+        from masters_thesis_tpu.train.checkpoint import (
+            CorruptCheckpointError,
+            restore_checkpoint,
+            verify_checkpoint,
+        )
+
+        if not ckpt_dirs:
+            raise ValueError("need at least one checkpoint directory")
+        params_list, spec0, lookback0 = [], None, None
+        for i, d in enumerate(ckpt_dirs):
+            path = Path(d) / tag
+            if not verify_checkpoint(path, require_manifest=True):
+                raise CorruptCheckpointError(
+                    f"refusing to serve lane {i} from {path}: strict "
+                    "manifest verification failed"
+                )
+            params, _, spec, meta = restore_checkpoint(d, tag)
+            lookback = meta.get("datamodule", {}).get("lookback_window")
+            if lookback is None:
+                raise ValueError(
+                    f"checkpoint sidecar for {path} has no "
+                    "datamodule.lookback_window; cannot size programs"
+                )
+            if spec0 is None:
+                spec0, lookback0 = spec, int(lookback)
+            elif spec != spec0 or int(lookback) != lookback0:
+                raise LaneMismatchError(
+                    f"lane {i} ({path}) spec/lookback differs from lane 0 "
+                    "— stacked serving requires identical architectures"
+                )
+            params_list.append(params)
+        return cls(
+            spec0,
+            params_list,
+            n_stocks=n_stocks,
+            lookback=lookback0,
+            n_features=n_features,
+            buckets=buckets,
+            mesh=mesh,
+            program_cache=program_cache,
+            lanes=lanes,
+        )

@@ -37,6 +37,13 @@ additive, never a new way for a healthy legacy checkpoint to fail.
 
 Fault point ``serve.pre_swap`` (kind ``corrupt``) corrupts the candidate
 tree before verification — the chaos suite's torn-checkpoint drill.
+
+Stacked engines get the same protocol per tenant lane:
+:meth:`CheckpointSwapper.try_swap_lane` runs verify → signature → canary →
+quality against ONE lane of a :class:`StackedPredictEngine`'s ``[R, n]``
+param stack, adds a bitwise sibling-isolation check (staging a candidate
+must not move any OTHER lane's outputs through the identical executable),
+and commits as an atomic row write — zero recompiles, zero sibling churn.
 """
 
 from __future__ import annotations
@@ -150,6 +157,8 @@ class CheckpointSwapper:
         self.quality = quality_monitor
         self.committed = 0
         self.rejected = 0
+        self.lane_committed = 0
+        self.lane_rejected = 0
 
     def _event(self, kind: str, **payload) -> None:
         if self.telemetry is not None:
@@ -279,6 +288,179 @@ class CheckpointSwapper:
             checks=verdict.checks,
         )
         return verdict
+
+    def try_swap_lane(
+        self, lane: int, ckpt_dir, tag: str = "best"
+    ) -> SwapVerdict:
+        """Per-lane swap against a :class:`StackedPredictEngine`: replace
+        ONE tenant's lane in the stacked param buffers while sibling lanes
+        keep serving bit-identical answers through the same compiled
+        programs.
+
+        Same one-way gate as :meth:`try_swap` (strict manifest verify,
+        restore, shape signature, golden-batch canary, quality gate), plus
+        a **sibling-isolation check**: the staged stack's outputs on every
+        OTHER lane must be bitwise equal to the serving stack's — a row
+        scatter that perturbs a sibling is a correctness bug, never noise,
+        because both runs go through the identical executable. The commit
+        is an atomic row write (:meth:`StackedPredictEngine.set_lane`):
+        shapes never change, so zero recompiles by construction.
+
+        The quality gate scores the candidate against its OWN shipped
+        fingerprint only; the live sketch tracks the served ensemble mean
+        and would false-alarm against any single lane.
+        """
+        from masters_thesis_tpu.train import checkpoint as ckpt
+
+        if not hasattr(self.engine, "stage_lane"):
+            raise TypeError(
+                "try_swap_lane requires a StackedPredictEngine; "
+                f"{type(self.engine).__name__} has no lanes"
+            )
+        lane = int(lane)
+        ckpt_dir = Path(ckpt_dir)
+        path = ckpt_dir / tag
+        if faults.fire("serve.pre_swap", tag=tag) == "corrupt":
+            ckpt._corrupt_tree(path, seed=faults.corruption_seed())
+        if not ckpt.verify_checkpoint(path, require_manifest=True):
+            return self._reject_lane(
+                tag, lane,
+                SwapVerdict(
+                    False, "verify_failed",
+                    f"strict manifest verification failed for {path} "
+                    "(torn/corrupt tree, or no MANIFEST.json)",
+                ),
+            )
+        try:
+            params, _, spec, meta = ckpt.restore_checkpoint(ckpt_dir, tag)
+        except Exception as exc:  # noqa: BLE001 — any restore failure rejects
+            return self._reject_lane(
+                tag, lane,
+                SwapVerdict(
+                    False, "restore_failed",
+                    f"{type(exc).__name__}: {exc}",
+                ),
+            )
+        if _tree_signature(params) != _tree_signature(
+            self.engine.lane_params(lane)
+        ):
+            return self._reject_lane(
+                tag, lane,
+                SwapVerdict(
+                    False, "shape_mismatch",
+                    "candidate param tree does not match the lane's serving "
+                    "tree (per-lane swap cannot change architecture — the "
+                    "stacked AOT programs are shape-specialized)",
+                ),
+            )
+        try:
+            staged = self.engine.stage_lane(lane, params)
+        except Exception as exc:  # noqa: BLE001 — staging failure rejects
+            return self._reject_lane(
+                tag, lane,
+                SwapVerdict(
+                    False, "stage_failed",
+                    f"{type(exc).__name__}: {exc}",
+                ),
+            )
+        cur_a, cur_b = self.engine.predict(self.golden_x)
+        stg_a, stg_b = self.engine.predict(self.golden_x, params=staged)
+        verdict = canary_checks(
+            (cur_a[:, lane, :], cur_b[:, lane, :]),
+            (stg_a[:, lane, :], stg_b[:, lane, :]),
+            max_abs=self.max_abs, max_drift=self.max_drift,
+        )
+        if not verdict.ok:
+            return self._reject_lane(tag, lane, verdict)
+        siblings_clean = all(
+            np.array_equal(cur_a[:, r, :], stg_a[:, r, :])
+            and np.array_equal(cur_b[:, r, :], stg_b[:, r, :])
+            for r in range(self.engine.num_lanes)
+            if r != lane
+        )
+        verdict.checks["siblings_bitwise"] = siblings_clean
+        if not siblings_clean:
+            return self._reject_lane(
+                tag, lane,
+                SwapVerdict(
+                    False, "sibling_perturbed",
+                    "staging the candidate moved a SIBLING lane's outputs "
+                    "through the identical executable — lane isolation is "
+                    "broken; refusing to commit",
+                    verdict.checks,
+                ),
+            )
+        fp = quality_lib.read_fingerprint(path)
+        try:
+            gold = (fp or {}).get("golden")
+            if gold is not None and tuple(gold["shape"][1:]) == tuple(
+                self.engine.window_shape
+            ):
+                q_x = quality_lib.golden_windows(
+                    *gold["shape"], seed=gold.get("seed", 0)
+                )
+                q_out = self._predict_lane_chunked(q_x, lane, staged)
+                ok, reason, detail, qchecks = quality_lib.quality_gate(
+                    fp, q_x, q_out[0], q_out[1], live=None
+                )
+                verdict.checks.update(qchecks)
+                if not ok:
+                    return self._reject_lane(
+                        tag, lane,
+                        SwapVerdict(False, reason, detail, verdict.checks),
+                    )
+        except Exception as exc:  # noqa: BLE001 — a malformed fingerprint
+            # must reject the candidate, never take the replica down.
+            return self._reject_lane(
+                tag, lane,
+                SwapVerdict(
+                    False, "quality_error",
+                    f"quality gate could not score the candidate: "
+                    f"{type(exc).__name__}: {exc}",
+                    verdict.checks,
+                ),
+            )
+        digest = self.engine.set_lane(lane, params, staged=staged)
+        self.lane_committed += 1
+        self._event(
+            "lane_swap_committed",
+            tag=tag,
+            lane=lane,
+            digest=digest,
+            epoch=meta.get("epoch"),
+            checks=verdict.checks,
+        )
+        return verdict
+
+    def _reject_lane(
+        self, tag: str, lane: int, verdict: SwapVerdict
+    ) -> SwapVerdict:
+        self.lane_rejected += 1
+        self._event(
+            "lane_swap_rejected",
+            tag=tag,
+            lane=lane,
+            reason=verdict.reason,
+            detail=verdict.detail,
+            checks=verdict.checks,
+        )
+        return verdict
+
+    def _predict_lane_chunked(
+        self, x: np.ndarray, lane: int, staged: Any
+    ) -> tuple:
+        """Lane-sliced :meth:`_predict_chunked` over a staged stack."""
+        cap = getattr(self.engine, "max_bucket", None)
+        if not cap or len(x) <= cap:
+            return self.engine.predict_lane(x, lane, params=staged)
+        outs = [
+            self.engine.predict_lane(x[i : i + cap], lane, params=staged)
+            for i in range(0, len(x), cap)
+        ]
+        return (
+            np.concatenate([np.asarray(o[0]) for o in outs]),
+            np.concatenate([np.asarray(o[1]) for o in outs]),
+        )
 
     def _predict_chunked(self, x: np.ndarray, params: Any) -> tuple:
         """Predict a golden batch that may exceed the engine's largest

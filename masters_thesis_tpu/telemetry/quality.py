@@ -42,6 +42,7 @@ __all__ = [
     "StreamSketch",
     "psi",
     "ks",
+    "infer_factors",
     "shadow_ols",
     "shadow_error",
     "golden_windows",
@@ -289,31 +290,68 @@ def ks(reference: dict, live: dict) -> float:
 # ------------------------------------------------------------- shadow OLS
 
 
-def shadow_ols(x):
+def infer_factors(n_features: int) -> int:
+    """Factor count K from a window's feature channel count.
+
+    The interaction-only pipeline layout (data/pipeline.py) is
+    ``[r_stock, f_1..f_K, r_stock*f_1..r_stock*f_K]`` → ``f = 2K + 1``.
+    ``f == 3`` is the scalar-market anchor (K = 1).
+    """
+    return 1 if n_features == 3 else max(1, (int(n_features) - 1) // 2)
+
+
+def shadow_ols(x, n_factors: int | None = None):
     """Closed-form per-window OLS (α, β) — the thesis baseline, in numpy.
 
-    Mirrors ``ops/linalg.ols`` + the ``evaluation.py`` slicing convention:
-    regressor = feature 1 of stock 0 (the market series), regressand =
-    feature 0 of every stock. ``x`` is ``(n, k, t, f)`` or one window
-    ``(k, t, f)``; returns ``(alpha, beta)`` each ``(n, k)``.
+    Mirrors ``ops/linalg.ols``/``ols_k`` + the ``evaluation.py`` slicing
+    convention: regressors = features ``1..K`` of stock 0 (the broadcast
+    factor series), regressand = feature 0 of every stock. ``x`` is
+    ``(n, k, t, f)`` or one window ``(k, t, f)``; ``n_factors`` overrides
+    the channel-count inference (:func:`infer_factors`).
+
+    Returns ``(alpha, beta)``: ``alpha`` is ``(n, k)``; ``beta`` is
+    ``(n, k)`` at K = 1 (the original scalar path, op for op — the
+    bitwise parity anchor) and ``(n, k, K)`` for K > 1 (one loading per
+    factor, the numpy twin of ``ops/linalg._batched_ols_k``).
     """
     x = np.asarray(x, dtype=np.float64)  # mtt: disable=TL104 -- host-only sketch/OLS math in f64; never traced
     if x.ndim == 3:
         x = x[None]
-    market = x[:, 0, :, 1]  # (n, t)
+    if n_factors is None:
+        n_factors = infer_factors(x.shape[-1])
     rets = x[:, :, :, 0]  # (n, k, t)
-    design = np.stack([np.ones_like(market), market], axis=-1)  # (n, t, 2)
-    gram = design.transpose(0, 2, 1) @ design  # (n, 2, 2)
-    moment = design.transpose(0, 2, 1) @ rets.transpose(0, 2, 1)  # (n, 2, k)
-    coef = np.linalg.pinv(gram) @ moment
-    return coef[:, 0, :], coef[:, 1, :]
+    if n_factors == 1:
+        # Scalar path kept op for op: K=1 results must stay bit-identical
+        # to every fingerprint and test pinned before K-factor support.
+        market = x[:, 0, :, 1]  # (n, t)
+        design = np.stack([np.ones_like(market), market], axis=-1)
+        gram = design.transpose(0, 2, 1) @ design  # (n, 2, 2)
+        moment = design.transpose(0, 2, 1) @ rets.transpose(0, 2, 1)
+        coef = np.linalg.pinv(gram) @ moment
+        return coef[:, 0, :], coef[:, 1, :]
+    factors = x[:, 0, :, 1 : 1 + n_factors]  # (n, t, K)
+    ones = np.ones(factors.shape[:-1] + (1,), factors.dtype)
+    design = np.concatenate([ones, factors], axis=-1)  # (n, t, K+1)
+    gram = design.transpose(0, 2, 1) @ design  # (n, K+1, K+1)
+    moment = design.transpose(0, 2, 1) @ rets.transpose(0, 2, 1)
+    coef = np.linalg.pinv(gram) @ moment  # (n, K+1, k)
+    return coef[:, 0, :], np.swapaxes(coef[:, 1:, :], -1, -2)
 
 
-def shadow_error(x, alpha, beta) -> float:
-    """Mean |model − shadow-OLS| disagreement over a window batch."""
-    sa, sb = shadow_ols(x)
+def shadow_error(x, alpha, beta, n_factors: int | None = None) -> float:
+    """Mean |model − shadow-OLS| disagreement over a window batch.
+
+    With K > 1 factors the OLS betas are ``(n, k, K)``; a model that
+    serves the full loading matrix is scored loading-for-loading, while
+    one that serves a single ``(n, k)`` beta is scored against the FIRST
+    factor's loading (the market line — the K = 1 semantics).
+    """
+    sa, sb = shadow_ols(x, n_factors=n_factors)
     a = np.asarray(alpha, dtype=np.float64).reshape(sa.shape)  # mtt: disable=TL104 -- host-only sketch/OLS math in f64; never traced
-    b = np.asarray(beta, dtype=np.float64).reshape(sb.shape)  # mtt: disable=TL104 -- host-only sketch/OLS math in f64; never traced
+    b = np.asarray(beta, dtype=np.float64)  # mtt: disable=TL104 -- host-only sketch/OLS math in f64; never traced
+    if b.size != sb.size and sb.ndim == 3:
+        sb = sb[..., 0]
+    b = b.reshape(sb.shape)
     return float(0.5 * (np.mean(np.abs(a - sa)) + np.mean(np.abs(b - sb))))
 
 
@@ -351,6 +389,10 @@ def build_fingerprint(
     alpha = np.asarray(alpha, dtype=np.float64)[: x.shape[0]]  # mtt: disable=TL104 -- host-only sketch/OLS math in f64; never traced
     beta = np.asarray(beta, dtype=np.float64)[: x.shape[0]]  # mtt: disable=TL104 -- host-only sketch/OLS math in f64; never traced
     sa, sb = shadow_ols(x)
+    if beta.size != sb.size and sb.ndim == 3:
+        # Single-loading model under a K-factor window: fingerprint the
+        # first factor's loading, matching shadow_error's convention.
+        sb = sb[..., 0]
     fp = {
         "version": FINGERPRINT_VERSION,
         "windows": int(x.shape[0]),

@@ -372,12 +372,16 @@ def _serve_stats(by_kind: dict) -> dict | None:
     raw_sheds = len(by_kind.get("request_shed", []))
     swaps_committed = len(by_kind.get("swap_committed", []))
     swaps_rejected = len(by_kind.get("swap_rejected", []))
+    lane_swaps_committed = len(by_kind.get("lane_swap_committed", []))
+    lane_swaps_rejected = len(by_kind.get("lane_swap_rejected", []))
     if not (
         finished
         or by_kind.get("serve_started")
         or raw_sheds
         or swaps_committed
         or swaps_rejected
+        or lane_swaps_committed
+        or lane_swaps_rejected
     ):
         return None
     last = finished[-1] if finished else {}
@@ -391,8 +395,14 @@ def _serve_stats(by_kind: dict) -> dict | None:
         "p50_ms": last.get("p50_ms"),
         "p99_ms": last.get("p99_ms"),
         "qps": last.get("qps"),
+        # Multi-tenant stacked serving: per-tenant admission accounting
+        # and lane count ride along on serve_finished when present.
+        "tenants": last.get("tenants"),
+        "lanes": last.get("lanes"),
         "swaps_committed": swaps_committed,
         "swaps_rejected": swaps_rejected,
+        "lane_swaps_committed": lane_swaps_committed,
+        "lane_swaps_rejected": lane_swaps_rejected,
         "degradations": len(by_kind.get("degradation", [])),
         "clean_stop": bool(finished),
     }

@@ -255,6 +255,23 @@ def replica_flat(stacked: dict, r: int) -> dict:
     return {k: v[r] for k, v in stacked.items()}
 
 
+def set_lane(stacked: dict, r: int, bufs: dict) -> dict:
+    """Functionally replace lane ``r``'s row across the stacked buffers.
+
+    The serving-side seam of the stack (serve/stacked.py): a per-lane
+    hot-swap writes ONE row of every ``[R, n]`` dtype buffer and leaves
+    every sibling row bit-untouched — ``.at[r].set`` is a row scatter, so
+    the result is a fresh stacked dict (the caller swaps the reference
+    atomically) whose other rows alias the old buffers' values exactly.
+    Shapes never change, so the AOT executables compiled against the
+    stack keep serving with zero recompiles.
+    """
+    return {
+        k: v.at[r].set(jnp.asarray(bufs[k], v.dtype))
+        for k, v in stacked.items()
+    }
+
+
 def stack_opt_states(states: list) -> FlatOptState:
     """R per-replica FlatOptStates -> one stacked state.
 

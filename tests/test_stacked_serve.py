@@ -1,0 +1,582 @@
+"""Multi-tenant stacked serving (ISSUE 20): R checkpoints behind ONE
+AOT predict program per bucket.
+
+The contract under test is bitwise: every lane of the stack must answer
+exactly as the solo engine serving the same checkpoint (a tenant
+migrating onto the stack must not be able to observe the move), a lane
+hot-swap must leave sibling lanes' outputs bit-untouched with zero
+recompiles, and the stacked program-cache entry must re-key when any
+lane's content changes while solo entries keep hitting.
+"""
+
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from masters_thesis_tpu.resilience import faults
+from masters_thesis_tpu.resilience.faults import FaultPlan, FaultSpec
+from masters_thesis_tpu.serve.queue import (
+    STATUS_OK,
+    STATUS_REJECTED_LATE,
+    STATUS_SHED,
+)
+
+# Tiny window shape shared by every engine in this file.
+K, T, F = 4, 8, 3
+BUCKETS = (1, 2, 4)
+R = 4
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults(monkeypatch):
+    """Every test starts and ends with injection off, whatever it does."""
+    monkeypatch.delenv(faults.FAULT_PLAN_ENV, raising=False)
+    monkeypatch.delenv(faults.ATTEMPT_ENV, raising=False)
+    yield
+    faults.clear_plan()
+
+
+def _tiny_spec(hidden=8):
+    from masters_thesis_tpu.models.objectives import ModelSpec
+
+    return ModelSpec(
+        objective="mse", hidden_size=hidden, num_layers=1, dropout=0.0,
+        kernel_impl="xla",
+    )
+
+
+def _init_params(spec, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    module = spec.build_module()
+    return module.init(
+        jax.random.key(seed), jnp.zeros((1, T, F), jnp.float32)
+    )["params"]
+
+
+def _solo_engine(spec, params, buckets=BUCKETS, **kw):
+    from masters_thesis_tpu.serve.engine import PredictEngine
+
+    return PredictEngine(
+        spec, params, n_stocks=K, lookback=T, n_features=F,
+        buckets=buckets, **kw,
+    )
+
+
+def _stacked_engine(spec, params_list, buckets=BUCKETS, **kw):
+    from masters_thesis_tpu.serve.stacked import StackedPredictEngine
+
+    return StackedPredictEngine(
+        spec, params_list, n_stocks=K, lookback=T, n_features=F,
+        buckets=buckets, **kw,
+    )
+
+
+def _save_ckpt(d, spec, params, epoch=1):
+    from masters_thesis_tpu.train.checkpoint import save_checkpoint
+
+    save_checkpoint(
+        Path(d), "best", params, {}, spec,
+        meta={"epoch": epoch, "datamodule": {"lookback_window": T}},
+    )
+
+
+def _window(n=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, K, T, F)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def stack_setup():
+    """One warmed R=4 stack plus the 4 solo engines it must mirror
+    bit-for-bit (read-only tests only — mutators build their own)."""
+    spec = _tiny_spec()
+    params = [_init_params(spec, seed=s) for s in range(R)]
+    stacked = _stacked_engine(spec, params)
+    stacked.warmup()
+    solos = [_solo_engine(spec, p) for p in params]
+    for s in solos:
+        s.warmup()
+    return spec, params, stacked, solos
+
+
+# -------------------------------------------------------- bitwise parity
+
+
+class TestLaneParity:
+    @pytest.mark.parametrize("n", [1, 2, 4])
+    def test_bitwise_parity_every_bucket(self, stack_setup, n):
+        _, _, stacked, solos = stack_setup
+        x = _window(n, seed=n)
+        alpha, beta = stacked.predict(x)
+        assert alpha.shape == (n, R, K) and beta.shape == (n, R, K)
+        for lane, solo in enumerate(solos):
+            sa, sb = solo.predict(x)
+            # Exact equality, not allclose: the scan runs each lane
+            # through the solo op sequence, so any ULP drift is a bug.
+            np.testing.assert_array_equal(alpha[:, lane, :], sa)
+            np.testing.assert_array_equal(beta[:, lane, :], sb)
+
+    def test_bitwise_parity_through_pad_path(self, stack_setup):
+        _, _, stacked, solos = stack_setup
+        x = _window(3, seed=7)  # pads up to bucket 4 in both engines
+        alpha, beta = stacked.predict(x)
+        for lane, solo in enumerate(solos):
+            sa, sb = solo.predict(x)
+            np.testing.assert_array_equal(alpha[:, lane, :], sa)
+            np.testing.assert_array_equal(beta[:, lane, :], sb)
+
+    def test_predict_lane_is_stack_slice(self, stack_setup):
+        _, _, stacked, _ = stack_setup
+        x = _window(2, seed=9)
+        alpha, beta = stacked.predict(x)
+        la, lb = stacked.predict_lane(x, lane=2)
+        np.testing.assert_array_equal(la, alpha[:, 2, :])
+        np.testing.assert_array_equal(lb, beta[:, 2, :])
+        with pytest.raises(IndexError):
+            stacked.predict_lane(x, lane=R)
+
+    def test_one_program_per_bucket(self, stack_setup):
+        _, _, stacked, _ = stack_setup
+        assert stacked.compile_events == len(BUCKETS)
+
+    def test_bucket_overflow_and_bad_shape(self, stack_setup):
+        from masters_thesis_tpu.serve.engine import BucketOverflowError
+
+        _, _, stacked, _ = stack_setup
+        with pytest.raises(BucketOverflowError):
+            stacked.predict(_window(5))
+        with pytest.raises(ValueError):
+            stacked.predict(np.zeros((1, K, T + 1, F), np.float32))
+
+    def test_mismatched_lane_architecture_refused(self, stack_setup):
+        from masters_thesis_tpu.serve.stacked import LaneMismatchError
+
+        spec, params, _, _ = stack_setup
+        odd = _init_params(_tiny_spec(hidden=16), seed=0)
+        with pytest.raises(LaneMismatchError):
+            _stacked_engine(spec, [params[0], odd])
+
+    def test_hlo_is_structurally_lane_count_invariant(self, stack_setup):
+        """The serving twin of TA207: the lane loop stays rolled, so the
+        compiled module's structure (SV307's fingerprint) must not grow
+        with R — only lane-dim literals in shape annotations may move."""
+        from masters_thesis_tpu.serve.preflight import _hlo_fingerprint
+
+        spec, params, stacked, _ = stack_setup
+        small = _stacked_engine(spec, params[:2], buckets=(1, 2))
+        small.warmup()
+        for b in (1, 2):
+            assert _hlo_fingerprint(small.compiled_text(b)) == \
+                _hlo_fingerprint(stacked.compiled_text(b))
+
+
+# -------------------------------------------------------- ensemble math
+
+
+class TestEnsemble:
+    def test_ensemble_stats_math(self):
+        from masters_thesis_tpu.serve.stacked import ensemble_stats
+
+        rng = np.random.default_rng(3)
+        alpha = rng.standard_normal((5, R, K))
+        beta = rng.standard_normal((5, R, K))
+        out = ensemble_stats(alpha, beta)
+        np.testing.assert_array_equal(out["alpha_mean"], alpha.mean(axis=1))
+        np.testing.assert_array_equal(out["alpha_std"], alpha.std(axis=1))
+        np.testing.assert_array_equal(out["beta_lo"], beta.min(axis=1))
+        np.testing.assert_array_equal(out["beta_hi"], beta.max(axis=1))
+        assert out["alpha_mean"].shape == (5, K)
+        assert out["alpha_mean"].dtype == np.float64
+
+    def test_ensemble_stats_rejects_non_lane_outputs(self):
+        from masters_thesis_tpu.serve.stacked import ensemble_stats
+
+        flat = np.zeros((5, K))
+        with pytest.raises(ValueError):
+            ensemble_stats(flat, flat)
+
+    def test_predict_ensemble_one_dispatch(self, stack_setup):
+        _, _, stacked, _ = stack_setup
+        x = _window(2, seed=11)
+        before = stacked.compile_events
+        out = stacked.predict_ensemble(x)
+        assert stacked.compile_events == before  # no retrace
+        np.testing.assert_array_equal(
+            out["alpha_mean"],
+            np.asarray(out["alpha"], np.float64).mean(axis=1),
+        )
+        band = out["alpha_hi"] - out["alpha_lo"]
+        assert (band >= 0).all()
+
+
+# ------------------------------------------------- lane swap + isolation
+
+
+class TestLaneSwap:
+    def _fresh(self):
+        spec = _tiny_spec()
+        params = [_init_params(spec, seed=s) for s in range(3)]
+        eng = _stacked_engine(spec, params)
+        eng.warmup()
+        return spec, params, eng
+
+    def test_set_lane_moves_one_row_only(self):
+        from masters_thesis_tpu.serve.stacked import lane_digest
+
+        spec, params, eng = self._fresh()
+        candidate = _init_params(spec, seed=99)
+        x = _window(4, seed=1)
+        pre_a, pre_b = eng.predict(x)
+        pre_digests = eng.lane_digests()
+        compiles = eng.compile_events
+
+        new_digest = eng.set_lane(1, candidate)
+
+        assert eng.compile_events == compiles  # zero recompiles (SV308)
+        post_digests = eng.lane_digests()
+        assert post_digests[1] == new_digest != pre_digests[1]
+        assert post_digests[0] == pre_digests[0]
+        assert post_digests[2] == pre_digests[2]
+        post_a, post_b = eng.predict(x)
+        for lane in (0, 2):  # siblings: bit-untouched
+            np.testing.assert_array_equal(pre_a[:, lane], post_a[:, lane])
+            np.testing.assert_array_equal(pre_b[:, lane], post_b[:, lane])
+        # The swapped lane now answers exactly as a solo engine on the
+        # candidate params.
+        solo = _solo_engine(spec, candidate)
+        solo.warmup()
+        sa, sb = solo.predict(x)
+        np.testing.assert_array_equal(post_a[:, 1], sa)
+        np.testing.assert_array_equal(post_b[:, 1], sb)
+
+    def test_stage_lane_does_not_commit(self):
+        spec, params, eng = self._fresh()
+        x = _window(2, seed=2)
+        pre = eng.predict(x)
+        pre_digests = eng.lane_digests()
+        staged = eng.stage_lane(0, _init_params(spec, seed=77))
+        staged_out = eng.predict(x, params=staged)
+        assert not np.array_equal(staged_out[0][:, 0], pre[0][:, 0])
+        # Sibling lanes inside the staged stack are already bitwise.
+        np.testing.assert_array_equal(staged_out[0][:, 1], pre[0][:, 1])
+        assert eng.lane_digests() == pre_digests
+        np.testing.assert_array_equal(eng.predict(x)[0], pre[0])
+
+    def test_set_lane_shape_mismatch_refused(self):
+        from masters_thesis_tpu.serve.stacked import LaneMismatchError
+
+        _, _, eng = self._fresh()
+        with pytest.raises(LaneMismatchError):
+            eng.set_lane(0, _init_params(_tiny_spec(hidden=16), seed=0))
+
+    def test_try_swap_lane_commits_with_sibling_proof(self, tmp_path):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+        from masters_thesis_tpu.telemetry.events import read_events
+        from masters_thesis_tpu.telemetry.run import TelemetryRun
+
+        spec, params, eng = self._fresh()
+        candidate = _init_params(spec, seed=50)
+        _save_ckpt(tmp_path / "cand", spec, candidate, epoch=3)
+        tel = TelemetryRun(tmp_path / "tel", run_id="swap")
+        ctl = CheckpointSwapper(eng, telemetry=tel)
+        x = _window(4, seed=4)
+        pre = eng.predict(x)
+
+        verdict = ctl.try_swap_lane(2, tmp_path / "cand")
+
+        assert verdict.ok, (verdict.reason, verdict.detail)
+        assert verdict.checks.get("siblings_bitwise") is True
+        assert ctl.lane_committed == 1 and ctl.lane_rejected == 0
+        post = eng.predict(x)
+        for lane in (0, 1):
+            np.testing.assert_array_equal(pre[0][:, lane], post[0][:, lane])
+        assert not np.array_equal(pre[0][:, 2], post[0][:, 2])
+        kinds = [e["kind"] for e in read_events(tel.run_dir / "events.jsonl")]
+        assert "lane_swap_committed" in kinds
+
+    def test_try_swap_lane_rejects_corrupt_candidate(self, tmp_path):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        spec, params, eng = self._fresh()
+        _save_ckpt(tmp_path / "cand", spec, _init_params(spec, seed=51))
+        ctl = CheckpointSwapper(eng)
+        pre_digests = eng.lane_digests()
+        faults.install_plan(FaultPlan(faults=[FaultSpec(
+            point="serve.pre_swap", kind="corrupt",
+        )]))
+        verdict = ctl.try_swap_lane(1, tmp_path / "cand")
+        assert not verdict.ok and verdict.reason == "verify_failed"
+        assert ctl.lane_rejected == 1 and ctl.lane_committed == 0
+        assert eng.lane_digests() == pre_digests
+
+    def test_try_swap_lane_requires_stacked_engine(self, tmp_path):
+        from masters_thesis_tpu.serve.swap import CheckpointSwapper
+
+        spec = _tiny_spec()
+        solo = _solo_engine(spec, _init_params(spec))
+        solo.warmup()
+        _save_ckpt(tmp_path / "cand", spec, _init_params(spec, seed=1))
+        with pytest.raises(TypeError):
+            CheckpointSwapper(solo).try_swap_lane(0, tmp_path / "cand")
+
+
+# ------------------------------------------- program-cache lane identity
+
+
+class TestProgramCacheLaneKeys:
+    def test_lane_swap_rekeys_stack_but_not_solo(self, tmp_path):
+        from masters_thesis_tpu.serve.program_cache import ProgramCache
+
+        spec = _tiny_spec()
+        params = [_init_params(spec, seed=s) for s in range(2)]
+        buckets = (1, 2)
+        cache = ProgramCache(tmp_path / "pc")
+
+        # Cold boot: every stacked bucket compiles and is stored.
+        cold = _stacked_engine(
+            spec, params, buckets=buckets, program_cache=cache
+        )
+        cold.warmup()
+        assert cold.compile_events == len(buckets)
+        assert cold.cache_hits == 0
+
+        # Solo engine for lane 0 stores its own (lane-digest-free) entries.
+        solo_cold = _solo_engine(
+            spec, params[0], buckets=buckets, program_cache=cache
+        )
+        solo_cold.warmup()
+        assert solo_cold.compile_events == len(buckets)
+
+        # Same lanes, same order -> every stacked program hits.
+        warm = _stacked_engine(
+            spec, params, buckets=buckets, program_cache=cache
+        )
+        warm.warmup()
+        assert warm.compile_events == 0
+        assert warm.cache_hits == len(buckets)
+        x = _window(2, seed=6)
+        np.testing.assert_array_equal(
+            warm.predict(x)[0], cold.predict(x)[0]
+        )
+
+        # One lane's content changes -> the stacked identity re-keys (the
+        # stored golden replays the OLD lane's outputs) and recompiles...
+        swapped = _stacked_engine(
+            spec, [params[0], _init_params(spec, seed=9)],
+            buckets=buckets, program_cache=cache,
+        )
+        swapped.warmup()
+        assert swapped.cache_hits == 0
+        assert swapped.compile_events == len(buckets)
+
+        # ...while the unchanged SOLO program still hits every bucket.
+        solo_warm = _solo_engine(
+            spec, params[0], buckets=buckets, program_cache=cache
+        )
+        solo_warm.warmup()
+        assert solo_warm.compile_events == 0
+        assert solo_warm.cache_hits == len(buckets)
+
+    def test_lane_order_is_part_of_the_key(self, tmp_path):
+        from masters_thesis_tpu.serve.program_cache import ProgramCache
+
+        spec = _tiny_spec()
+        params = [_init_params(spec, seed=s) for s in range(2)]
+        cache = ProgramCache(tmp_path / "pc")
+        a = _stacked_engine(spec, params, buckets=(1,), program_cache=cache)
+        a.warmup()
+        # Same two checkpoints, reversed lanes: a different stack.
+        b = _stacked_engine(
+            spec, params[::-1], buckets=(1,), program_cache=cache
+        )
+        b.warmup()
+        assert b.cache_hits == 0 and b.compile_events == 1
+
+
+# ------------------------------------------------- tenancy on the server
+
+
+class TestServerTenancy:
+    def test_tenant_deadline_class_and_accounting(self, stack_setup):
+        from masters_thesis_tpu.serve.server import PredictServer
+
+        _, _, stacked, _ = stack_setup
+        server = PredictServer(stacked, max_wait_s=0.001)
+        server.start()
+        try:
+            server.register_tenant("quant-a", deadline_s=5.0)
+            pend = [
+                server.submit(_window(1, seed=i)[0], tenant="quant-a")
+                for i in range(3)
+            ]
+            pend.append(
+                server.submit(_window(1, seed=9)[0], 5.0, tenant="quant-b")
+            )
+            results = [p.result(timeout=10.0) for p in pend]
+            assert all(r.status == STATUS_OK for r in results)
+            # Stacked engines answer (R, K) per request.
+            assert results[0].outputs[0].shape == (R, K)
+            with pytest.raises(ValueError):
+                server.submit(_window()[0], tenant="no-class")
+        finally:
+            stats = server.stop()
+        assert stats["tenants"]["quant-a"]["admitted"] == 3
+        assert stats["tenants"]["quant-b"]["admitted"] == 1
+        assert stats["lanes"] == R
+        assert stats["late_deliveries"] == 0
+
+
+# ----------------------------------------------- chaos: replica kill, R=4
+
+
+@pytest.mark.slow
+def test_stacked_fleet_replica_kill_zero_late():
+    """A 2-replica stacked fleet (R=4 lanes each) loses one replica to an
+    injected dispatch crash mid-stream: every request resolves with an
+    explicit status, nothing is delivered late, and the survivor keeps
+    answering for all four tenants."""
+    from masters_thesis_tpu.resilience.supervisor import ReplicaRestartPolicy
+    from masters_thesis_tpu.serve.fleet import FleetServer, partition_meshes
+
+    spec = _tiny_spec()
+    params = [_init_params(spec, seed=s) for s in range(R)]
+    meshes = partition_meshes(2)
+
+    def factory_for(m):
+        return lambda: _stacked_engine(
+            spec, params, buckets=(1, 2), mesh=m
+        )
+
+    fleet = FleetServer(
+        {f"r{i}": factory_for(m) for i, m in enumerate(meshes)},
+        max_wait_s=0.002,
+        hang_timeout_s=2.0,
+        restart_policy=ReplicaRestartPolicy(backoff_s=0.01),
+    )
+    fleet.start()
+    try:
+        faults.install_plan(FaultPlan(faults=[FaultSpec(
+            point="serve.replica_dispatch", kind="raise", attempt=1,
+            match={"replica": "r0"},
+        )]))
+        pend = [
+            fleet.submit(_window(1, seed=i)[0], deadline_s=5.0)
+            for i in range(30)
+        ]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and fleet.deaths < 1:
+            time.sleep(0.01)
+        faults.clear_plan()
+        results = [p.result(timeout=10.0) for p in pend]
+        assert all(
+            r.status in (STATUS_OK, STATUS_SHED, STATUS_REJECTED_LATE)
+            for r in results
+        )
+        ok = [r for r in results if r.status == STATUS_OK]
+        assert ok and all(r.outputs[0].shape == (R, K) for r in ok)
+    finally:
+        stats = fleet.stop()
+    assert stats["deaths"] >= 1
+    assert stats["late_deliveries"] == 0
+    assert stats["lanes"] == R
+
+
+# ------------------------------------------------------- bucket plumbing
+
+
+class TestBucketConfig:
+    def test_resolve_buckets_forms(self):
+        from masters_thesis_tpu.serve.engine import (
+            DEFAULT_BUCKETS,
+            resolve_buckets,
+        )
+
+        assert resolve_buckets(None) == DEFAULT_BUCKETS
+        assert resolve_buckets("1,4, 8") == (1, 4, 8)
+        assert resolve_buckets("64 32") == (32, 64)
+        assert resolve_buckets([8, 1, 4, 4]) == (1, 4, 8)
+        with pytest.raises(ValueError):
+            resolve_buckets("0,4")
+
+    def test_serve_config_group_composes(self):
+        from masters_thesis_tpu.config import compose, register_resolver
+
+        register_resolver(
+            "input_size_from_interaction", lambda i: 3 if i else 5
+        )
+        cfg = compose("configs")
+        assert list(cfg["serve"]["buckets"]) == [1, 2, 4, 8]
+        deep = compose("configs", overrides=["serve=universe"])
+        assert list(deep["serve"]["buckets"]) == [1, 2, 4, 8, 16, 32, 64]
+        assert deep["serve"]["max_depth"] > cfg["serve"]["max_depth"]
+
+
+# ------------------------------------------------ K-factor shadow quality
+
+
+class TestKFactorShadow:
+    def test_infer_factors_from_feature_layout(self):
+        from masters_thesis_tpu.telemetry.quality import infer_factors
+
+        # f = 2K + 1 (windows.py: [r_stock, f_1..f_K, cross terms]).
+        assert infer_factors(3) == 1
+        assert infer_factors(5) == 2
+        assert infer_factors(7) == 3
+
+    def test_shadow_ols_k1_is_the_scalar_path_bitwise(self):
+        """The K=1 branch must stay op-for-op the original scalar shadow
+        (the drift-sketch baselines in shipped fingerprints depend on its
+        exact rounding)."""
+        from masters_thesis_tpu.telemetry.quality import shadow_ols
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5, K, T, 3))
+        alpha, beta = shadow_ols(x)
+        # Inline re-statement of the original scalar algorithm.
+        xs = np.asarray(x, np.float64)
+        market = xs[:, 0, :, 1]
+        design = np.stack([np.ones_like(market), market], axis=-1)
+        gram = np.einsum("nti,ntj->nij", design, design)
+        moment = np.einsum("nti,nkt->nik", design, xs[..., 0])
+        coef = np.linalg.pinv(gram) @ moment
+        np.testing.assert_array_equal(alpha, coef[:, 0, :])
+        np.testing.assert_array_equal(beta, coef[:, 1, :])
+        assert alpha.shape == (5, K) and beta.shape == (5, K)
+
+    def test_shadow_ols_k_factor_matches_device_twin(self):
+        import jax.numpy as jnp
+
+        from masters_thesis_tpu.ops.linalg import ols_k
+        from masters_thesis_tpu.telemetry.quality import shadow_ols
+
+        n_factors = 3
+        f = 2 * n_factors + 1
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 6, T, f)).astype(np.float32)
+        alpha, beta = shadow_ols(x)
+        assert alpha.shape == (4, 6) and beta.shape == (4, 6, n_factors)
+        # Device twin takes the sliced series directly: factor returns
+        # come from stock 0's broadcast channels, regressand is channel 0.
+        factors = jnp.asarray(x[:, 0, :, 1 : 1 + n_factors])  # (n, t, K)
+        y = jnp.asarray(x[..., 0])  # (n, k, t)
+        da, db = ols_k(factors, y)
+        np.testing.assert_allclose(alpha, np.asarray(da), atol=2e-4)
+        np.testing.assert_allclose(beta, np.asarray(db), atol=2e-4)
+
+    def test_shadow_error_scores_both_loading_conventions(self):
+        from masters_thesis_tpu.telemetry.quality import (
+            shadow_error,
+            shadow_ols,
+        )
+
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((3, K, T, 7))
+        alpha, beta = shadow_ols(x)  # full loadings (n, k, K)
+        assert shadow_error(x, alpha, beta) < 1e-9
+        # A K=1-era model ships a single loading per stock; it is scored
+        # against the FIRST factor's loading: self-consistent there too.
+        assert shadow_error(x, alpha, beta[..., 0]) < 1e-9

@@ -100,10 +100,12 @@ echo "== resilience fleet selfcheck =="
 python -m masters_thesis_tpu.resilience fleet --selfcheck || fail=1
 
 # 3c. serving: jax-free smoke of the request path (queue/admission/
-#     deadline/breaker/canary with a fake engine), then the serve
-#     preflight on the hermetic 8-device virtual CPU mesh — every predict
-#     bucket compiles exactly once and the hot path is clean under
-#     transfer_guard("disallow") (rules SV301-SV303).
+#     deadline/breaker/canary/multi-tenant stacked dispatch with a fake
+#     engine), then the serve preflight on the hermetic 8-device virtual
+#     CPU mesh — every predict bucket compiles exactly once, the hot path
+#     is clean under transfer_guard("disallow"), stacked lanes share one
+#     program per bucket, and a lane hot-swap is zero-compile with zero
+#     late answers (rules SV301-SV308).
 echo "== serve selfcheck =="
 python -m masters_thesis_tpu.serve selfcheck || fail=1
 echo "== serve preflight =="
